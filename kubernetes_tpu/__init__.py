@@ -1,0 +1,27 @@
+"""kubernetes_tpu — a TPU-native cluster orchestrator.
+
+A from-scratch framework with the capabilities of Kubernetes (reference:
+v1.3.0-alpha era), re-designed TPU-first: the control plane (API server,
+versioned watchable storage, informer-based state replication, controllers,
+node agent, proxy, CLI) is host-side Python; the scheduler's filter-and-score
+pipeline — the system's computational hot loop — is a batched JAX/XLA kernel
+over dense pods x nodes tensors, sharded across a TPU device mesh.
+
+Layer map (mirrors SURVEY.md §1):
+  api/        L3  typed resources, selectors, validation, serialization
+  storage/    L0  versioned KV + watch window (etcd + watchCache equivalent)
+  registry/   L1  generic REST store + per-resource strategies
+  apiserver/  L2  HTTP CRUD + LIST/WATCH streaming
+  client/     L4  RESTClient, Reflector, FIFO, Informer, listers, events
+  scheduler/  L5  shell (cache/factory/binder) + Python oracle + TPU backend
+  controllers/L6  reconciliation loops
+  kubelet/    L7  node agent (hollow-capable)
+  proxy/      L8  service dataplane rule compiler
+  kubectl/    L9  CLI
+  kubemark/   LX  hollow-node scale harness
+  ops/        TPU kernels (tensorize, filter-and-score, greedy commit)
+  parallel/   device mesh + sharding helpers
+  utils/      workqueue, backoff, clock, trace, flowcontrol
+"""
+
+__version__ = "0.1.0"

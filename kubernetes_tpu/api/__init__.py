@@ -1,0 +1,9 @@
+"""API machinery: typed resources, quantities, selectors, validation, codecs.
+
+Parity target: reference pkg/api (types), pkg/labels, pkg/fields,
+pkg/api/resource (Quantity), pkg/api/validation, pkg/runtime (Scheme/codec).
+"""
+
+from kubernetes_tpu.api.quantity import parse_quantity, parse_cpu, parse_memory, format_cpu, format_memory
+from kubernetes_tpu.api import labels
+from kubernetes_tpu.api import fields

@@ -1,0 +1,70 @@
+"""Field selector algebra.
+
+Parity target: reference pkg/fields — equality matching over a flat set of
+per-object field paths. The load-bearing use is the scheduler's unassigned-pod
+ListWatch (`spec.nodeName=`) and kubelet's assigned-pod watch
+(`spec.nodeName=<me>`); also `status.phase`, `metadata.name` filters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+
+class FieldSelectorError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class FieldRequirement:
+    key: str
+    value: str
+    negate: bool = False
+
+    def matches(self, fields: Mapping[str, str]) -> bool:
+        got = fields.get(self.key, "")
+        return (got != self.value) if self.negate else (got == self.value)
+
+
+@dataclass(frozen=True)
+class FieldSelector:
+    requirements: tuple = ()
+
+    def matches(self, fields: Mapping[str, str]) -> bool:
+        return all(r.matches(fields) for r in self.requirements)
+
+    def empty(self) -> bool:
+        return not self.requirements
+
+    def __str__(self) -> str:
+        return ",".join(
+            f"{r.key}!={r.value}" if r.negate else f"{r.key}={r.value}"
+            for r in self.requirements
+        )
+
+
+def everything() -> FieldSelector:
+    return FieldSelector(())
+
+
+def parse_field_selector(s: Optional[str]) -> FieldSelector:
+    if not s or not s.strip():
+        return everything()
+    reqs = []
+    for clause in s.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "!=" in clause:
+            k, v = clause.split("!=", 1)
+            reqs.append(FieldRequirement(k.strip(), v.strip(), negate=True))
+        elif "==" in clause:
+            k, v = clause.split("==", 1)
+            reqs.append(FieldRequirement(k.strip(), v.strip()))
+        elif "=" in clause:
+            k, v = clause.split("=", 1)
+            reqs.append(FieldRequirement(k.strip(), v.strip()))
+        else:
+            raise FieldSelectorError(f"invalid field selector clause: {clause!r}")
+    return FieldSelector(tuple(reqs))

@@ -1,0 +1,199 @@
+"""Label selector algebra.
+
+Parity target: reference pkg/labels (selector.go) — the matching language used
+by every LIST/WATCH, by services/RCs to select pods, and by scheduler
+predicates (PodSelectorMatches, ServiceAffinity) and priorities
+(SelectorSpread). Supports:
+
+  equality-based:  a=b, a==b, a!=b
+  set-based:       a in (v1,v2), a notin (v1), a, !a
+  conjunction:     comma-separated requirements
+
+Also the matchLabels/matchExpressions structured form used by NodeAffinity /
+PodAffinity (reference pkg/apis/extensions + pkg/api/unversioned
+LabelSelector), with operators In, NotIn, Exists, DoesNotExist, Gt, Lt.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+_OPS = {IN, NOT_IN, EXISTS, DOES_NOT_EXIST, GT, LT}
+
+
+class SelectorError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """One term of a selector: key <op> values."""
+
+    key: str
+    op: str
+    values: tuple = ()
+
+    def __post_init__(self):
+        if self.op not in _OPS:
+            raise SelectorError(f"unknown operator {self.op!r}")
+        object.__setattr__(self, "values", tuple(self.values))
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        has = self.key in labels
+        if self.op == EXISTS:
+            return has
+        if self.op == DOES_NOT_EXIST:
+            return not has
+        if self.op == IN:
+            return has and labels[self.key] in self.values
+        if self.op == NOT_IN:
+            # reference semantics: a key that is absent still satisfies notin
+            return not has or labels[self.key] not in self.values
+        # Gt/Lt compare integer values; absent key never matches
+        if not has:
+            return False
+        try:
+            lhs = int(labels[self.key])
+            rhs = int(self.values[0])
+        except (ValueError, IndexError):
+            return False
+        return lhs > rhs if self.op == GT else lhs < rhs
+
+
+@dataclass(frozen=True)
+class Selector:
+    """Conjunction of requirements. Empty selector matches everything."""
+
+    requirements: tuple = ()
+
+    def matches(self, labels: Optional[Mapping[str, str]]) -> bool:
+        labels = labels or {}
+        return all(r.matches(labels) for r in self.requirements)
+
+    def empty(self) -> bool:
+        return not self.requirements
+
+    def __str__(self) -> str:
+        parts = []
+        for r in self.requirements:
+            if r.op == EXISTS:
+                parts.append(r.key)
+            elif r.op == DOES_NOT_EXIST:
+                parts.append("!" + r.key)
+            elif r.op == IN and len(r.values) == 1:
+                parts.append(f"{r.key}={r.values[0]}")
+            elif r.op == IN:
+                parts.append(f"{r.key} in ({','.join(sorted(r.values))})")
+            elif r.op == NOT_IN:
+                parts.append(f"{r.key} notin ({','.join(sorted(r.values))})")
+            elif r.op == GT and r.values:
+                parts.append(f"{r.key}>{r.values[0]}")
+            elif r.op == LT and r.values:
+                parts.append(f"{r.key}<{r.values[0]}")
+            else:
+                parts.append(r.key)
+        return ",".join(parts)
+
+
+def everything() -> Selector:
+    return Selector(())
+
+
+def nothing() -> Selector:
+    # An impossible requirement; used where the reference returns labels.Nothing()
+    return Selector((Requirement("\x00nothing", IN, ()),))
+
+
+def selector_from_map(m: Optional[Mapping[str, str]]) -> Selector:
+    """SelectorFromSet: exact-match on every pair. None -> match nothing
+    (mirrors how a nil selector on a service/RC selects no pods)."""
+    if m is None:
+        return nothing()
+    return Selector(tuple(Requirement(k, IN, (v,)) for k, v in sorted(m.items())))
+
+
+def selector_from_label_selector(ls) -> Selector:
+    """Convert the structured LabelSelector form {matchLabels, matchExpressions}
+    (dict or api.types.LabelSelector) into a Selector. None -> match nothing,
+    empty -> match everything (reference LabelSelectorAsSelector semantics)."""
+    if ls is None:
+        return nothing()
+    if hasattr(ls, "match_labels"):
+        match_labels = ls.match_labels or {}
+        match_exprs = ls.match_expressions or []
+    else:
+        match_labels = ls.get("matchLabels") or {}
+        match_exprs = ls.get("matchExpressions") or []
+    reqs = [Requirement(k, IN, (v,)) for k, v in sorted(match_labels.items())]
+    for e in match_exprs:
+        if hasattr(e, "key"):
+            key, op, values = e.key, e.operator, tuple(e.values or ())
+        else:
+            key, op, values = e["key"], e["operator"], tuple(e.get("values") or ())
+        reqs.append(Requirement(key, op, values))
+    return Selector(tuple(reqs))
+
+
+# --- string parser ("a=b,c in (d,e),!f,cores>4") -----------------------------
+
+def parse_selector(s: Optional[str]) -> Selector:
+    """Parse the string selector syntax. Empty/None matches everything."""
+    if not s or not s.strip():
+        return everything()
+    reqs = []
+    for clause in _split_clauses(s):
+        reqs.append(_parse_clause(clause.strip()))
+    return Selector(tuple(reqs))
+
+
+def _split_clauses(s: str):
+    """Split on commas not inside parentheses."""
+    depth, start = 0, 0
+    for i, ch in enumerate(s):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            yield s[start:i]
+            start = i + 1
+    yield s[start:]
+
+
+_CLAUSE_EQ = re.compile(r"^([A-Za-z0-9_./-]+)\s*(==|!=|=)\s*([A-Za-z0-9_.-]*)$")
+_CLAUSE_CMP = re.compile(r"^([A-Za-z0-9_./-]+)\s*(>|<)\s*([0-9-]+)$")
+_CLAUSE_SET = re.compile(r"^([A-Za-z0-9_./-]+)\s+(in|notin)\s+\(([^)]*)\)$")
+_CLAUSE_EXISTS = re.compile(r"^([A-Za-z0-9_./-]+)$")
+_CLAUSE_NEXISTS = re.compile(r"^!\s*([A-Za-z0-9_./-]+)$")
+
+
+def _parse_clause(c: str) -> Requirement:
+    if not c:
+        raise SelectorError("empty selector clause")
+    m = _CLAUSE_SET.match(c)
+    if m:
+        values = tuple(v.strip() for v in m.group(3).split(","))
+        return Requirement(m.group(1), IN if m.group(2) == "in" else NOT_IN, values)
+    m = _CLAUSE_EQ.match(c)
+    if m:
+        op = NOT_IN if m.group(2) == "!=" else IN
+        return Requirement(m.group(1), op, (m.group(3),))
+    m = _CLAUSE_CMP.match(c)
+    if m:
+        return Requirement(m.group(1), GT if m.group(2) == ">" else LT, (m.group(3),))
+    m = _CLAUSE_NEXISTS.match(c)
+    if m:
+        return Requirement(m.group(1), DOES_NOT_EXIST)
+    m = _CLAUSE_EXISTS.match(c)
+    if m:
+        return Requirement(m.group(1), EXISTS)
+    raise SelectorError(f"couldn't parse selector clause: {c!r}")

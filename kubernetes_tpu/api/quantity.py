@@ -1,0 +1,125 @@
+"""Resource quantity parsing.
+
+Parity target: reference pkg/api/resource/quantity.go — Kubernetes quantity
+strings ("100m" CPU, "500Mi" memory, "1.5Gi", "2e3", "1k") normalised to
+integers the scheduler can put in tensors:
+
+  cpu    -> milliCPU (int)   e.g. "100m" -> 100, "2" -> 2000
+  memory -> bytes (int)      e.g. "500Mi" -> 524288000, "1G" -> 1e9
+  other  -> plain integer counts (gpu, pods)
+
+The TPU decision plane works on int32/float32 tensors, so quantities are
+canonicalised at the API boundary exactly once (the reference instead carries
+inf.Dec decimals everywhere and converts in the scheduler hot loop —
+predicates.go:416 calls Resource.MilliValue() per decision; we pay it once).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+# Binary (power-of-two) suffixes: Ki, Mi, Gi, Ti, Pi, Ei
+_BINARY = {
+    "Ki": 2**10,
+    "Mi": 2**20,
+    "Gi": 2**30,
+    "Ti": 2**40,
+    "Pi": 2**50,
+    "Ei": 2**60,
+}
+# Decimal SI suffixes, including milli
+_DECIMAL = {
+    "m": Fraction(1, 1000),
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+}
+
+
+class QuantityError(ValueError):
+    pass
+
+
+def _parse(s) -> Fraction:
+    """Parse a quantity string into an exact Fraction of base units."""
+    if isinstance(s, (int, float)):
+        try:
+            return Fraction(s).limit_denominator(10**9)
+        except (ValueError, OverflowError):
+            raise QuantityError(f"invalid quantity: {s!r}") from None
+    if not isinstance(s, str) or not s:
+        raise QuantityError(f"invalid quantity: {s!r}")
+    s = s.strip()
+    # exponent form: 2e3, 1.5E2 — but beware suffix 'E' (exa) which only
+    # follows a bare number with no digits after; "12E" is exa, "12E3" is exp.
+    suffix = ""
+    body = s
+    for suf in _BINARY:
+        if s.endswith(suf):
+            suffix = suf
+            body = s[: -len(suf)]
+            break
+    else:
+        # single-char decimal suffixes; 'E'/'e' ambiguity with exponent:
+        # treat trailing E/e with digits before it and nothing after as exa.
+        if s and s[-1] in _DECIMAL and not (s[-1] in "Ee" and _looks_like_exponent(s)):
+            suffix = s[-1]
+            body = s[:-1]
+    try:
+        num = Fraction(body)
+    except (ValueError, ZeroDivisionError):
+        try:
+            num = Fraction(float(body)).limit_denominator(10**12)
+        except (ValueError, OverflowError):
+            raise QuantityError(f"invalid quantity: {s!r}") from None
+    if suffix:
+        num *= Fraction(_BINARY.get(suffix) or _DECIMAL[suffix])
+    return num
+
+
+def _looks_like_exponent(s: str) -> bool:
+    # "12e3" / "1.5E-2" style; a trailing 'E' like "12E" is the exa suffix.
+    low = s.lower()
+    if "e" not in low:
+        return False
+    idx = low.rindex("e")
+    return idx < len(s) - 1  # digits follow the e
+
+
+def parse_fraction(s) -> Fraction:
+    """Parse to the exact Fraction (for sign/shape checks that must not be
+    affected by integer rounding, e.g. validation of '-100m')."""
+    return _parse(s)
+
+
+def parse_quantity(s) -> int:
+    """Parse to an integer count (rounding up, like Quantity.Value())."""
+    f = _parse(s)
+    return int(-(-f.numerator // f.denominator))  # ceil
+
+
+def parse_cpu(s) -> int:
+    """Parse a CPU quantity to milliCPU (Quantity.MilliValue(), rounds up)."""
+    f = _parse(s) * 1000
+    return int(-(-f.numerator // f.denominator))
+
+
+def parse_memory(s) -> int:
+    """Parse a memory quantity to bytes."""
+    return parse_quantity(s)
+
+
+def format_cpu(milli: int) -> str:
+    if milli % 1000 == 0:
+        return str(milli // 1000)
+    return f"{milli}m"
+
+
+def format_memory(b: int) -> str:
+    for suf, mult in (("Gi", 2**30), ("Mi", 2**20), ("Ki", 2**10)):
+        if b % mult == 0 and b >= mult:
+            return f"{b // mult}{suf}"
+    return str(b)

@@ -1,0 +1,183 @@
+"""Scheme + codec: typed, versioned, JSON-serializable API objects.
+
+Parity target: reference pkg/runtime (Scheme, codecs) + pkg/conversion.
+Instead of Go's reflection-based conversion machinery with generated deep
+copies, objects are Python dataclasses and the codec walks type hints:
+snake_case attributes <-> camelCase JSON keys (with per-field overrides),
+nested dataclasses, lists, and string maps. A kind registry maps
+("v1", "Pod") <-> class so untyped JSON can be decoded (runtime.Scheme
+AddKnownTypes, pkg/runtime/scheme.go:160).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from typing import Any, Optional, Type
+
+_JSON_NAME_KEY = "json"
+_camel_cache: dict = {}
+
+
+def camel(name: str) -> str:
+    c = _camel_cache.get(name)
+    if c is None:
+        parts = name.split("_")
+        c = parts[0] + "".join(p.capitalize() for p in parts[1:])
+        _camel_cache[name] = c
+    return c
+
+
+def api_field(json_name: Optional[str] = None, default=dataclasses.MISSING,
+              default_factory=dataclasses.MISSING):
+    """dataclasses.field with an explicit wire name (for irregular casing
+    like hostIP, clusterIP, uid)."""
+    md = {_JSON_NAME_KEY: json_name} if json_name else {}
+    kw = {"metadata": md}
+    if default is not dataclasses.MISSING:
+        kw["default"] = default
+    if default_factory is not dataclasses.MISSING:
+        kw["default_factory"] = default_factory
+    return dataclasses.field(**kw)
+
+
+def _wire_name(f: dataclasses.Field) -> str:
+    return f.metadata.get(_JSON_NAME_KEY) or camel(f.name)
+
+
+_hints_cache: dict = {}
+
+
+def _hints(cls):
+    h = _hints_cache.get(cls)
+    if h is None:
+        h = typing.get_type_hints(cls)
+        _hints_cache[cls] = h
+    return h
+
+
+def _strip_optional(t):
+    if typing.get_origin(t) is typing.Union:
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return t
+
+
+def to_dict(obj: Any) -> Any:
+    """Serialize a dataclass (or container/scalar) to JSON-ready plain data.
+    Fields equal to their default are omitted (omitempty everywhere, which is
+    how the reference's versioned types behave on the wire)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            if f.default is not dataclasses.MISSING and v == f.default:
+                continue
+            if f.default_factory is not dataclasses.MISSING and v == f.default_factory():
+                continue
+            out[_wire_name(f)] = to_dict(v)
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def from_dict(cls: Type, data: Any) -> Any:
+    """Decode plain data into dataclass `cls`, walking type hints. Unknown
+    keys are ignored (forward compatibility, like Go JSON decoding)."""
+    if data is None:
+        return None
+    if not dataclasses.is_dataclass(cls):
+        return data
+    hints = _hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        wire = _wire_name(f)
+        if wire not in data:
+            continue
+        raw = data[wire]
+        kwargs[f.name] = _decode_value(_strip_optional(hints[f.name]), raw)
+    return cls(**kwargs)
+
+
+def _decode_value(t, raw):
+    if raw is None:
+        return None
+    origin = typing.get_origin(t)
+    if origin in (list, tuple):
+        (elem,) = typing.get_args(t) or (Any,)
+        elem = _strip_optional(elem)
+        seq = [_decode_value(elem, v) for v in raw]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        args = typing.get_args(t)
+        velem = _strip_optional(args[1]) if len(args) == 2 else Any
+        return {k: _decode_value(velem, v) for k, v in raw.items()}
+    if dataclasses.is_dataclass(t):
+        return from_dict(t, raw)
+    return raw
+
+
+# --- kind registry (the Scheme) ----------------------------------------------
+
+class Scheme:
+    """Registry of (apiVersion, kind) <-> class, plus encode/decode with
+    TypeMeta injection. Mirrors runtime.Scheme (pkg/runtime/scheme.go:43)."""
+
+    def __init__(self):
+        self._by_kind: dict = {}
+        self._by_cls: dict = {}
+
+    def add_known_type(self, api_version: str, kind: str, cls: Type):
+        self._by_kind[(api_version, kind)] = cls
+        self._by_cls[cls] = (api_version, kind)
+
+    def kind_for(self, cls_or_obj) -> tuple:
+        cls = cls_or_obj if isinstance(cls_or_obj, type) else type(cls_or_obj)
+        try:
+            return self._by_cls[cls]
+        except KeyError:
+            raise KeyError(f"type {cls.__name__} not registered in scheme") from None
+
+    def class_for(self, api_version: str, kind: str) -> Type:
+        try:
+            return self._by_kind[(api_version, kind)]
+        except KeyError:
+            raise KeyError(f"no kind {kind!r} registered for {api_version!r}") from None
+
+    def encode(self, obj) -> dict:
+        d = to_dict(obj)
+        api_version, kind = self.kind_for(obj)
+        d["apiVersion"] = api_version
+        d["kind"] = kind
+        return d
+
+    def encode_json(self, obj) -> str:
+        return json.dumps(self.encode(obj), separators=(",", ":"))
+
+    def decode(self, data: dict):
+        cls = self.class_for(data.get("apiVersion", "v1"), data["kind"])
+        return from_dict(cls, data)
+
+    def decode_json(self, s) -> Any:
+        return self.decode(json.loads(s))
+
+    def decode_into(self, cls: Type, data: dict):
+        return from_dict(cls, data)
+
+
+scheme = Scheme()  # the default scheme; api.types registers into it on import
+
+
+def deep_copy(obj):
+    """Deep copy via the codec (cheap for our dataclasses; the reference
+    generates deep-copy functions per type)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return from_dict(type(obj), to_dict(obj))
+    return json.loads(json.dumps(obj))

@@ -1,0 +1,736 @@
+"""Core API types.
+
+Parity target: reference pkg/api/types.go (2,869 ln) / pkg/api/v1/types.go —
+the subset that carries the system's behavior: Pod, Node, Service, Endpoints,
+ReplicationController, ReplicaSet, Binding, Event, Namespace, PV/PVC, plus the
+scheduling-relevant sub-structs (ResourceRequirements, Affinity, Taint,
+Toleration, NodeSelector*). Python dataclasses, wire-compatible camelCase JSON
+via api.serialization.
+
+Scheduling-critical fields (the tensorization surface, SURVEY §7):
+  Pod.spec.node_name        — the binding target (PodSpec.NodeName)
+  Pod.spec.containers[].resources.requests — cpu/mem/gpu demands
+  Node.status.allocatable   — capacity vector incl. "pods" slot count
+  Affinity / Taint / Toleration / node_selector — constraint language
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.serialization import api_field, scheme
+
+# Well-known resource names (reference pkg/api/types.go ResourceName consts)
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_GPU = "alpha.kubernetes.io/nvidia-gpu"
+RESOURCE_PODS = "pods"
+
+# Pod phases
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+POD_UNKNOWN = "Unknown"
+
+# Condition types / statuses
+POD_SCHEDULED = "PodScheduled"
+POD_READY = "Ready"
+NODE_READY = "Ready"
+NODE_OUT_OF_DISK = "OutOfDisk"
+NODE_MEMORY_PRESSURE = "MemoryPressure"
+CONDITION_TRUE = "True"
+CONDITION_FALSE = "False"
+CONDITION_UNKNOWN = "Unknown"
+
+# Taint effects
+TAINT_NO_SCHEDULE = "NoSchedule"
+TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+
+# Toleration operators
+TOLERATION_OP_EXISTS = "Exists"
+TOLERATION_OP_EQUAL = "Equal"
+
+# Annotation keys (v1.3-era alpha features lived in annotations; kept for
+# wire compat — see factory multi-scheduler dispatch, reference factory.go:50)
+ANN_SCHEDULER_NAME = "scheduler.alpha.kubernetes.io/name"
+ANN_CREATED_BY = "kubernetes.io/created-by"
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+# Well-known node label for zone/region topology (reference unversioned well_known_labels)
+LABEL_ZONE = "failure-domain.beta.kubernetes.io/zone"
+LABEL_REGION = "failure-domain.beta.kubernetes.io/region"
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    generate_name: str = ""
+    namespace: str = ""
+    uid: str = api_field("uid", default="")
+    resource_version: str = ""
+    creation_timestamp: Optional[str] = None
+    deletion_timestamp: Optional[str] = None
+    labels: Optional[Dict[str, str]] = None
+    annotations: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class ListMeta:
+    resource_version: str = ""
+
+
+@dataclass
+class ObjectReference:
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = api_field("uid", default="")
+    api_version: str = ""
+    resource_version: str = ""
+    field_path: str = ""
+
+
+# --- label selector (structured form) ---------------------------------------
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str = ""
+    operator: str = ""
+    values: Optional[List[str]] = None
+
+
+@dataclass
+class LabelSelector:
+    match_labels: Optional[Dict[str, str]] = None
+    match_expressions: Optional[List[LabelSelectorRequirement]] = None
+
+
+# --- node affinity ------------------------------------------------------------
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = ""  # In, NotIn, Exists, DoesNotExist, Gt, Lt
+    values: Optional[List[str]] = None
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: Optional[List[NodeSelectorRequirement]] = None
+
+
+@dataclass
+class NodeSelector:
+    node_selector_terms: Optional[List[NodeSelectorTerm]] = None  # ORed
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 0  # 1-100
+    preference: Optional[NodeSelectorTerm] = None
+
+
+@dataclass
+class NodeAffinity:
+    required_during_scheduling_ignored_during_execution: Optional[NodeSelector] = None
+    preferred_during_scheduling_ignored_during_execution: Optional[List[PreferredSchedulingTerm]] = None
+
+
+# --- pod (anti-)affinity ------------------------------------------------------
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    namespaces: Optional[List[str]] = None  # empty => pod's own namespace
+    topology_key: str = ""
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 0
+    pod_affinity_term: Optional[PodAffinityTerm] = None
+
+
+@dataclass
+class PodAffinity:
+    required_during_scheduling_ignored_during_execution: Optional[List[PodAffinityTerm]] = None
+    preferred_during_scheduling_ignored_during_execution: Optional[List[WeightedPodAffinityTerm]] = None
+
+
+@dataclass
+class PodAntiAffinity:
+    required_during_scheduling_ignored_during_execution: Optional[List[PodAffinityTerm]] = None
+    preferred_during_scheduling_ignored_during_execution: Optional[List[WeightedPodAffinityTerm]] = None
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+# --- taints & tolerations -----------------------------------------------------
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = ""  # NoSchedule | PreferNoSchedule
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = ""  # Exists | Equal ("" == Equal)
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        """Reference plugin/pkg/scheduler/algorithm/predicates/predicates.go:949
+        (TolerationToleratesTaint)."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        # empty toleration key is a wildcard matching every taint key
+        if self.key and self.key != taint.key:
+            return False
+        op = self.operator or TOLERATION_OP_EQUAL
+        if op == TOLERATION_OP_EXISTS:
+            return True
+        return self.value == taint.value
+
+
+# --- volumes (scheduling-relevant sources only) ------------------------------
+
+@dataclass
+class GCEPersistentDiskVolumeSource:
+    pd_name: str = api_field("pdName", default="")
+    fs_type: str = ""
+    partition: int = 0
+    read_only: bool = False
+
+
+@dataclass
+class AWSElasticBlockStoreVolumeSource:
+    volume_id: str = api_field("volumeID", default="")
+    fs_type: str = ""
+    partition: int = 0
+    read_only: bool = False
+
+
+@dataclass
+class RBDVolumeSource:
+    monitors: Optional[List[str]] = api_field("monitors", default=None)
+    image: str = ""
+    pool: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class ISCSIVolumeSource:
+    target_portal: str = ""
+    iqn: str = api_field("iqn", default="")
+    lun: int = 0
+    read_only: bool = False
+
+
+@dataclass
+class PersistentVolumeClaimVolumeSource:
+    claim_name: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class EmptyDirVolumeSource:
+    medium: str = ""
+
+
+@dataclass
+class HostPathVolumeSource:
+    path: str = ""
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    rbd: Optional[RBDVolumeSource] = api_field("rbd", default=None)
+    iscsi: Optional[ISCSIVolumeSource] = api_field("iscsi", default=None)
+    persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
+    empty_dir: Optional[EmptyDirVolumeSource] = None
+    host_path: Optional[HostPathVolumeSource] = None
+
+
+# --- containers & pod ---------------------------------------------------------
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = api_field("hostIP", default="")
+
+
+@dataclass
+class ResourceRequirements:
+    # values are quantity strings ("100m", "500Mi") or numbers
+    limits: Optional[Dict[str, str]] = None
+    requests: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: Optional[List[str]] = None
+    args: Optional[List[str]] = None
+    ports: Optional[List[ContainerPort]] = None
+    env: Optional[List[EnvVar]] = None
+    resources: Optional[ResourceRequirements] = None
+
+
+@dataclass
+class PodSpec:
+    containers: Optional[List[Container]] = None
+    volumes: Optional[List[Volume]] = None
+    node_selector: Optional[Dict[str, str]] = None
+    node_name: str = ""  # set only via the binding subresource
+    restart_policy: str = ""
+    termination_grace_period_seconds: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    service_account_name: str = ""
+    host_network: bool = False
+    affinity: Optional[Affinity] = None         # first-class (annotation in v1.3)
+    tolerations: Optional[List[Toleration]] = None  # first-class (annotation in v1.3)
+    scheduler_name: str = ""                    # first-class (annotation in v1.3)
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+    last_probe_time: Optional[str] = None
+    last_transition_time: Optional[str] = None
+
+
+@dataclass
+class ContainerStateRunning:
+    started_at: Optional[str] = None
+
+
+@dataclass
+class ContainerStateTerminated:
+    exit_code: int = 0
+    reason: str = ""
+    started_at: Optional[str] = None
+    finished_at: Optional[str] = None
+
+
+@dataclass
+class ContainerStateWaiting:
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class ContainerState:
+    waiting: Optional[ContainerStateWaiting] = None
+    running: Optional[ContainerStateRunning] = None
+    terminated: Optional[ContainerStateTerminated] = None
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    state: Optional[ContainerState] = None
+    ready: bool = False
+    restart_count: int = 0
+    image: str = ""
+    container_id: str = api_field("containerID", default="")
+
+
+@dataclass
+class PodStatus:
+    phase: str = ""
+    conditions: Optional[List[PodCondition]] = None
+    message: str = ""
+    reason: str = ""
+    host_ip: str = api_field("hostIP", default="")
+    pod_ip: str = api_field("podIP", default="")
+    start_time: Optional[str] = None
+    container_statuses: Optional[List[ContainerStatus]] = None
+
+
+@dataclass
+class Pod:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[PodSpec] = None
+    status: Optional[PodStatus] = None
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[PodSpec] = None
+
+
+# --- node ---------------------------------------------------------------------
+
+@dataclass
+class NodeSpec:
+    pod_cidr: str = api_field("podCIDR", default="")
+    provider_id: str = api_field("providerID", default="")
+    unschedulable: bool = False
+    taints: Optional[List[Taint]] = None  # first-class (annotation in v1.3)
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+    last_heartbeat_time: Optional[str] = None
+    last_transition_time: Optional[str] = None
+
+
+@dataclass
+class NodeAddress:
+    type: str = ""
+    address: str = ""
+
+
+@dataclass
+class ContainerImage:
+    names: Optional[List[str]] = None
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeSystemInfo:
+    machine_id: str = api_field("machineID", default="")
+    kernel_version: str = ""
+    os_image: str = api_field("osImage", default="")
+    container_runtime_version: str = ""
+    kubelet_version: str = ""
+
+
+@dataclass
+class NodeStatus:
+    capacity: Optional[Dict[str, str]] = None
+    allocatable: Optional[Dict[str, str]] = None
+    phase: str = ""
+    conditions: Optional[List[NodeCondition]] = None
+    addresses: Optional[List[NodeAddress]] = None
+    node_info: Optional[NodeSystemInfo] = None
+    images: Optional[List[ContainerImage]] = None
+
+
+@dataclass
+class Node:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[NodeSpec] = None
+    status: Optional[NodeStatus] = None
+
+
+# --- binding (the scheduler's single write) ----------------------------------
+
+@dataclass
+class Binding:
+    """POST /namespaces/{ns}/bindings — sets pod.spec.node_name iff empty
+    (reference pkg/registry/pod/etcd/etcd.go:118-189)."""
+    metadata: Optional[ObjectMeta] = None
+    target: Optional[ObjectReference] = None
+
+
+# --- service / endpoints ------------------------------------------------------
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    protocol: str = "TCP"
+    port: int = 0
+    target_port: Optional[object] = None  # int or str (named port)
+    node_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    ports: Optional[List[ServicePort]] = None
+    selector: Optional[Dict[str, str]] = None
+    cluster_ip: str = api_field("clusterIP", default="")
+    type: str = ""
+    session_affinity: str = ""
+
+
+@dataclass
+class ServiceStatus:
+    pass
+
+
+@dataclass
+class Service:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[ServiceSpec] = None
+    status: Optional[ServiceStatus] = None
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = api_field("ip", default="")
+    node_name: Optional[str] = None
+    target_ref: Optional[ObjectReference] = None
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class EndpointSubset:
+    addresses: Optional[List[EndpointAddress]] = None
+    not_ready_addresses: Optional[List[EndpointAddress]] = None
+    ports: Optional[List[EndpointPort]] = None
+
+
+@dataclass
+class Endpoints:
+    metadata: Optional[ObjectMeta] = None
+    subsets: Optional[List[EndpointSubset]] = None
+
+
+# --- controllers' objects -----------------------------------------------------
+
+@dataclass
+class ReplicationControllerSpec:
+    replicas: int = 0
+    selector: Optional[Dict[str, str]] = None
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class ReplicationControllerStatus:
+    replicas: int = 0
+    fully_labeled_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicationController:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[ReplicationControllerSpec] = None
+    status: Optional[ReplicationControllerStatus] = None
+
+
+@dataclass
+class ReplicaSetSpec:
+    replicas: int = 0
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class ReplicaSetStatus:
+    replicas: int = 0
+    fully_labeled_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicaSet:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[ReplicaSetSpec] = None
+    status: Optional[ReplicaSetStatus] = None
+
+
+# --- namespace / events / pv --------------------------------------------------
+
+@dataclass
+class NamespaceSpec:
+    finalizers: Optional[List[str]] = None
+
+
+@dataclass
+class NamespaceStatus:
+    phase: str = ""
+
+
+@dataclass
+class Namespace:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[NamespaceSpec] = None
+    status: Optional[NamespaceStatus] = None
+
+
+@dataclass
+class EventSource:
+    component: str = ""
+    host: str = ""
+
+
+@dataclass
+class Event:
+    metadata: Optional[ObjectMeta] = None
+    involved_object: Optional[ObjectReference] = None
+    reason: str = ""
+    message: str = ""
+    source: Optional[EventSource] = None
+    first_timestamp: Optional[str] = None
+    last_timestamp: Optional[str] = None
+    count: int = 0
+    type: str = ""
+
+
+@dataclass
+class PersistentVolumeSpec:
+    capacity: Optional[Dict[str, str]] = None
+    access_modes: Optional[List[str]] = None
+    gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    claim_ref: Optional[ObjectReference] = None
+    persistent_volume_reclaim_policy: str = ""
+
+
+@dataclass
+class PersistentVolumeStatus:
+    phase: str = ""
+
+
+@dataclass
+class PersistentVolume:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[PersistentVolumeSpec] = None
+    status: Optional[PersistentVolumeStatus] = None
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: Optional[List[str]] = None
+    resources: Optional[ResourceRequirements] = None
+    volume_name: str = ""
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = ""
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[PersistentVolumeClaimSpec] = None
+    status: Optional[PersistentVolumeClaimStatus] = None
+
+
+# --- status (error payloads, reference pkg/api/unversioned Status) -----------
+
+@dataclass
+class Status:
+    status: str = ""  # Success | Failure
+    message: str = ""
+    reason: str = ""
+    code: int = 0
+
+
+# --- registration ------------------------------------------------------------
+
+_V1_KINDS = {
+    "Pod": Pod,
+    "Node": Node,
+    "Binding": Binding,
+    "Service": Service,
+    "Endpoints": Endpoints,
+    "ReplicationController": ReplicationController,
+    "Namespace": Namespace,
+    "Event": Event,
+    "PersistentVolume": PersistentVolume,
+    "PersistentVolumeClaim": PersistentVolumeClaim,
+    "Status": Status,
+}
+for _kind, _cls in _V1_KINDS.items():
+    scheme.add_known_type("v1", _kind, _cls)
+scheme.add_known_type("extensions/v1beta1", "ReplicaSet", ReplicaSet)
+
+
+# --- helpers ------------------------------------------------------------------
+
+def new_pod(name: str, namespace: str = "default", **spec_kwargs) -> Pod:
+    return Pod(metadata=ObjectMeta(name=name, namespace=namespace),
+               spec=PodSpec(**spec_kwargs), status=PodStatus(phase=POD_PENDING))
+
+
+def pod_resource_request(pod: Pod) -> Dict[str, int]:
+    """Sum container requests into canonical integer units:
+    cpu -> milliCPU, memory -> bytes, gpu/pods -> counts.
+    Reference schedulercache/node_info.go:158 calculateResource."""
+    from kubernetes_tpu.api.quantity import parse_cpu, parse_quantity
+    cpu = mem = gpu = 0
+    for c in (pod.spec.containers if pod.spec and pod.spec.containers else []):
+        req = (c.resources.requests if c.resources and c.resources.requests else {})
+        cpu += parse_cpu(req.get(RESOURCE_CPU, 0))
+        mem += parse_quantity(req.get(RESOURCE_MEMORY, 0))
+        gpu += parse_quantity(req.get(RESOURCE_GPU, 0))
+    return {RESOURCE_CPU: cpu, RESOURCE_MEMORY: mem, RESOURCE_GPU: gpu}
+
+
+def node_allocatable(node: Node) -> Dict[str, int]:
+    """Allocatable (falls back to capacity) in canonical integer units.
+    Reference NodeStatus.Allocatable semantics."""
+    from kubernetes_tpu.api.quantity import parse_cpu, parse_quantity
+    st = node.status or NodeStatus()
+    src = st.allocatable or st.capacity or {}
+    return {
+        RESOURCE_CPU: parse_cpu(src.get(RESOURCE_CPU, 0)),
+        RESOURCE_MEMORY: parse_quantity(src.get(RESOURCE_MEMORY, 0)),
+        RESOURCE_GPU: parse_quantity(src.get(RESOURCE_GPU, 0)),
+        RESOURCE_PODS: parse_quantity(src.get(RESOURCE_PODS, 0)),
+    }
+
+
+def get_pod_scheduler_name(pod: Pod) -> str:
+    """Multi-scheduler dispatch: spec.scheduler_name, falling back to the
+    v1.3 annotation (reference factory.go:426-432 responsibleForPod)."""
+    if pod.spec and pod.spec.scheduler_name:
+        return pod.spec.scheduler_name
+    ann = (pod.metadata.annotations or {}) if pod.metadata else {}
+    return ann.get(ANN_SCHEDULER_NAME, DEFAULT_SCHEDULER_NAME)
+
+
+def object_fields(obj) -> Dict[str, str]:
+    """Flat field map for field selectors (reference pkg/registry/<r>/strategy.go
+    <Resource>ToSelectableFields)."""
+    meta = getattr(obj, "metadata", None) or ObjectMeta()
+    out = {"metadata.name": meta.name, "metadata.namespace": meta.namespace}
+    if isinstance(obj, Pod):
+        out["spec.nodeName"] = obj.spec.node_name if obj.spec else ""
+        out["status.phase"] = obj.status.phase if obj.status else ""
+    elif isinstance(obj, Node):
+        out["spec.unschedulable"] = str(bool(obj.spec and obj.spec.unschedulable)).lower()
+    elif isinstance(obj, Event):
+        io = obj.involved_object or ObjectReference()
+        out.update({
+            "involvedObject.kind": io.kind,
+            "involvedObject.namespace": io.namespace,
+            "involvedObject.name": io.name,
+            "involvedObject.uid": io.uid,
+            "reason": obj.reason,
+            "source": (obj.source.component if obj.source else ""),
+            "type": obj.type,
+        })
+    return out

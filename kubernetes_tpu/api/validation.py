@@ -1,0 +1,172 @@
+"""Declarative validation.
+
+Parity target: reference pkg/api/validation/validation.go (3,140 ln) — the
+load-bearing subset: object meta (DNS-1123 names, namespace rules), pod spec
+(containers present, unique names, resource requests parseable and
+non-negative, port ranges), node, service, and binding validation
+(ValidatePodBinding)."""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import QuantityError, parse_fraction
+
+_DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_DNS1123_SUBDOMAIN = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
+_QUALIFIED_NAME = re.compile(r"^([A-Za-z0-9][-A-Za-z0-9_./]*)?[A-Za-z0-9]$")
+
+
+class ValidationError(ValueError):
+    def __init__(self, errors: List[str]):
+        self.errors = errors
+        super().__init__("; ".join(errors))
+
+
+def _check(errs, cond, msg):
+    if not cond:
+        errs.append(msg)
+
+
+def validate_object_meta(meta: Optional[api.ObjectMeta], namespaced: bool,
+                         errs: List[str], prefix: str = "metadata"):
+    if meta is None:
+        errs.append(f"{prefix}: required")
+        return
+    name = meta.name
+    _check(errs, bool(name or meta.generate_name), f"{prefix}.name: required")
+    if name:
+        _check(errs, len(name) <= 253 and _DNS1123_SUBDOMAIN.match(name),
+               f"{prefix}.name: must be a DNS-1123 subdomain: {name!r}")
+    elif meta.generate_name:
+        # generateName is a prefix; a random suffix is appended, so a trailing
+        # '-' is conventional and must validate (reference ValidateObjectMeta)
+        gen = meta.generate_name.rstrip("-")
+        _check(errs, len(meta.generate_name) <= 247 and (not gen or _DNS1123_SUBDOMAIN.match(gen)),
+               f"{prefix}.generateName: must be a DNS-1123 subdomain prefix: {meta.generate_name!r}")
+    if namespaced:
+        _check(errs, bool(meta.namespace), f"{prefix}.namespace: required")
+        if meta.namespace:
+            _check(errs, _DNS1123_LABEL.match(meta.namespace),
+                   f"{prefix}.namespace: must be a DNS-1123 label: {meta.namespace!r}")
+    else:
+        _check(errs, not meta.namespace, f"{prefix}.namespace: not allowed on cluster-scoped object")
+    for k in (meta.labels or {}):
+        _check(errs, _QUALIFIED_NAME.match(k.rsplit("/", 1)[-1]),
+               f"{prefix}.labels: invalid key {k!r}")
+
+
+def _validate_resource_list(rl, errs, prefix):
+    for k, v in (rl or {}).items():
+        try:
+            # exact fraction: ceil-to-int would round "-100m" up to 0
+            q = parse_fraction(v)
+            _check(errs, q >= 0, f"{prefix}.{k}: must be non-negative")
+        except QuantityError:
+            errs.append(f"{prefix}.{k}: invalid quantity {v!r}")
+
+
+def validate_pod(pod: api.Pod) -> None:
+    errs: List[str] = []
+    validate_object_meta(pod.metadata, True, errs)
+    spec = pod.spec
+    if spec is None or not spec.containers:
+        errs.append("spec.containers: at least one container required")
+    else:
+        seen = set()
+        for i, c in enumerate(spec.containers):
+            p = f"spec.containers[{i}]"
+            _check(errs, bool(c.name), f"{p}.name: required")
+            _check(errs, c.name not in seen, f"{p}.name: duplicate {c.name!r}")
+            seen.add(c.name)
+            _check(errs, bool(c.image), f"{p}.image: required")
+            if c.resources:
+                _validate_resource_list(c.resources.requests, errs, f"{p}.resources.requests")
+                _validate_resource_list(c.resources.limits, errs, f"{p}.resources.limits")
+            for j, port in enumerate(c.ports or []):
+                _check(errs, 0 < port.container_port < 65536,
+                       f"{p}.ports[{j}].containerPort: out of range")
+                _check(errs, 0 <= port.host_port < 65536,
+                       f"{p}.ports[{j}].hostPort: out of range")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_node(node: api.Node) -> None:
+    errs: List[str] = []
+    validate_object_meta(node.metadata, False, errs)
+    if node.status:
+        _validate_resource_list(node.status.capacity, errs, "status.capacity")
+        _validate_resource_list(node.status.allocatable, errs, "status.allocatable")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_service(svc: api.Service) -> None:
+    errs: List[str] = []
+    validate_object_meta(svc.metadata, True, errs)
+    spec = svc.spec
+    if spec is None or not spec.ports:
+        errs.append("spec.ports: required")
+    else:
+        for i, p in enumerate(spec.ports):
+            _check(errs, 0 < p.port < 65536, f"spec.ports[{i}].port: out of range")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_binding(binding: api.Binding) -> None:
+    """Reference ValidatePodBinding: target kind must be Node (or empty) and
+    target name set."""
+    errs: List[str] = []
+    if binding.target is None:
+        errs.append("target: required")
+    else:
+        _check(errs, binding.target.kind in ("", "Node"),
+               f"target.kind: must be Node, got {binding.target.kind!r}")
+        _check(errs, bool(binding.target.name), "target.name: required")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_namespace(ns: api.Namespace) -> None:
+    errs: List[str] = []
+    validate_object_meta(ns.metadata, False, errs)
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_replication_controller(rc: api.ReplicationController) -> None:
+    errs: List[str] = []
+    validate_object_meta(rc.metadata, True, errs)
+    spec = rc.spec
+    if spec is None:
+        errs.append("spec: required")
+    else:
+        _check(errs, spec.replicas >= 0, "spec.replicas: must be non-negative")
+        _check(errs, bool(spec.selector), "spec.selector: required")
+        if spec.template:
+            tpl_labels = (spec.template.metadata.labels or {}) if spec.template.metadata else {}
+            for k, v in (spec.selector or {}).items():
+                _check(errs, tpl_labels.get(k) == v,
+                       f"spec.template.metadata.labels: must satisfy selector ({k}={v})")
+    if errs:
+        raise ValidationError(errs)
+
+
+VALIDATORS = {
+    api.Pod: validate_pod,
+    api.Node: validate_node,
+    api.Service: validate_service,
+    api.Binding: validate_binding,
+    api.Namespace: validate_namespace,
+    api.ReplicationController: validate_replication_controller,
+}
+
+
+def validate(obj) -> None:
+    v = VALIDATORS.get(type(obj))
+    if v:
+        v(obj)

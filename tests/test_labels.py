@@ -1,0 +1,100 @@
+"""Label/field selector tests (reference pkg/labels/selector_test.go patterns)."""
+
+import pytest
+
+from kubernetes_tpu.api import labels
+from kubernetes_tpu.api.fields import parse_field_selector
+from kubernetes_tpu.api.labels import (
+    Requirement, Selector, SelectorError, parse_selector, selector_from_label_selector,
+    selector_from_map,
+)
+
+
+class TestParse:
+    @pytest.mark.parametrize("s,lbls,want", [
+        ("a=b", {"a": "b"}, True),
+        ("a=b", {"a": "c"}, False),
+        ("a==b", {"a": "b"}, True),
+        ("a!=b", {"a": "c"}, True),
+        ("a!=b", {"a": "b"}, False),
+        ("a!=b", {}, True),              # absent key satisfies !=
+        ("a in (b,c)", {"a": "c"}, True),
+        ("a in (b,c)", {"a": "d"}, False),
+        ("a notin (b)", {"a": "c"}, True),
+        ("a notin (b)", {"a": "b"}, False),
+        ("a notin (b)", {}, True),
+        ("a", {"a": "anything"}, True),
+        ("a", {}, False),
+        ("!a", {}, True),
+        ("!a", {"a": "x"}, False),
+        ("a=b,c in (d, e),!f", {"a": "b", "c": "e"}, True),
+        ("a=b,c in (d, e),!f", {"a": "b", "c": "e", "f": "1"}, False),
+        ("", {"anything": "goes"}, True),
+        (None, {}, True),
+    ])
+    def test_matches(self, s, lbls, want):
+        assert parse_selector(s).matches(lbls) is want
+
+    @pytest.mark.parametrize("bad", ["a in b", "=x", "a in (b"])
+    def test_invalid(self, bad):
+        with pytest.raises(SelectorError):
+            parse_selector(bad)
+
+
+def test_selector_from_map():
+    sel = selector_from_map({"app": "web", "tier": "fe"})
+    assert sel.matches({"app": "web", "tier": "fe", "extra": "ok"})
+    assert not sel.matches({"app": "web"})
+    # nil selector matches nothing (how nil RC/service selectors behave)
+    assert not selector_from_map(None).matches({})
+    # empty selector matches everything
+    assert selector_from_map({}).matches({"x": "y"})
+
+
+def test_structured_label_selector():
+    ls = {"matchLabels": {"app": "db"},
+          "matchExpressions": [
+              {"key": "tier", "operator": "In", "values": ["be", "mid"]},
+              {"key": "canary", "operator": "DoesNotExist"}]}
+    sel = selector_from_label_selector(ls)
+    assert sel.matches({"app": "db", "tier": "be"})
+    assert not sel.matches({"app": "db", "tier": "fe"})
+    assert not sel.matches({"app": "db", "tier": "be", "canary": "y"})
+    assert not selector_from_label_selector(None).matches({})
+
+
+def test_gt_lt():
+    gt = Selector((Requirement("cores", labels.GT, ("4",)),))
+    assert gt.matches({"cores": "8"})
+    assert not gt.matches({"cores": "2"})
+    assert not gt.matches({})
+    assert not gt.matches({"cores": "notanumber"})
+    lt = Selector((Requirement("cores", labels.LT, ("4",)),))
+    assert lt.matches({"cores": "2"})
+
+
+def test_selector_str_roundtrip():
+    cases = ["a=b", "a in (b,c)", "!a", "a,b notin (c)", "cores>4", "cores<4"]
+    samples = [{}, {"a": "b"}, {"a": "c"}, {"b": "c"}, {"cores": "8"},
+               {"cores": "2"}, {"a": "b", "b": "x", "cores": "4"}]
+    for s in cases:
+        sel = parse_selector(s)
+        reparsed = parse_selector(str(sel))
+        for lbls in samples:
+            assert reparsed.matches(lbls) == sel.matches(lbls), (s, lbls)
+
+
+class TestFieldSelector:
+    def test_basic(self):
+        fs = parse_field_selector("spec.nodeName=")
+        assert fs.matches({"spec.nodeName": ""})
+        assert not fs.matches({"spec.nodeName": "node1"})
+
+    def test_neq(self):
+        fs = parse_field_selector("status.phase!=Failed,status.phase!=Succeeded")
+        assert fs.matches({"status.phase": "Running"})
+        assert not fs.matches({"status.phase": "Failed"})
+
+    def test_empty_matches_all(self):
+        assert parse_field_selector("").matches({"anything": "x"})
+        assert parse_field_selector(None).matches({})

@@ -1,0 +1,59 @@
+"""Quantity parsing (reference pkg/api/resource/quantity_test.go semantics)."""
+
+import pytest
+
+from kubernetes_tpu.api.quantity import (
+    QuantityError, format_cpu, format_memory, parse_cpu, parse_memory, parse_quantity,
+)
+
+
+@pytest.mark.parametrize("s,milli", [
+    ("100m", 100),
+    ("1", 1000),
+    ("2", 2000),
+    ("0.5", 500),
+    ("1500m", 1500),
+    ("2.5", 2500),
+    (1, 1000),
+    (0.1, 100),
+    ("0", 0),
+])
+def test_parse_cpu(s, milli):
+    assert parse_cpu(s) == milli
+
+
+@pytest.mark.parametrize("s,b", [
+    ("500Mi", 500 * 2**20),
+    ("1Gi", 2**30),
+    ("128974848", 128974848),
+    ("1G", 10**9),
+    ("100k", 100_000),
+    ("1.5Gi", 3 * 2**29),
+    ("2e3", 2000),
+    ("0", 0),
+])
+def test_parse_memory(s, b):
+    assert parse_memory(s) == b
+
+
+def test_milli_rounds_up():
+    # Quantity.MilliValue rounds up: 1 byte -> 1 milli-unit
+    assert parse_cpu("0.0001") == 1
+
+
+def test_exa_vs_exponent():
+    assert parse_quantity("2E") == 2 * 10**18
+    assert parse_quantity("2E2") == 200
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "1.2.3", None, "Mi"])
+def test_invalid(bad):
+    with pytest.raises((QuantityError, TypeError)):
+        parse_quantity(bad)
+
+
+def test_format_roundtrip():
+    assert format_cpu(100) == "100m"
+    assert format_cpu(2000) == "2"
+    assert format_memory(2**30) == "1Gi"
+    assert parse_memory(format_memory(524288000)) == 524288000

@@ -1,0 +1,193 @@
+"""Type machinery: codec roundtrips, scheme registry, helpers, validation."""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy, from_dict, scheme, to_dict
+from kubernetes_tpu.api.validation import ValidationError, validate
+
+
+def mk_pod():
+    return api.Pod(
+        metadata=api.ObjectMeta(name="web-1", namespace="default",
+                                labels={"app": "web"}, uid="u1"),
+        spec=api.PodSpec(
+            containers=[api.Container(
+                name="c", image="nginx",
+                ports=[api.ContainerPort(container_port=80, host_port=8080)],
+                resources=api.ResourceRequirements(
+                    requests={"cpu": "100m", "memory": "500Mi"}))],
+            node_selector={"disk": "ssd"},
+            tolerations=[api.Toleration(key="k", operator="Exists", effect="NoSchedule")],
+            affinity=api.Affinity(node_affinity=api.NodeAffinity(
+                required_during_scheduling_ignored_during_execution=api.NodeSelector(
+                    node_selector_terms=[api.NodeSelectorTerm(match_expressions=[
+                        api.NodeSelectorRequirement(key="zone", operator="In",
+                                                    values=["us-a", "us-b"])])]))),
+        ),
+        status=api.PodStatus(phase="Pending"),
+    )
+
+
+def test_pod_roundtrip_wire_names():
+    pod = mk_pod()
+    d = scheme.encode(pod)
+    assert d["kind"] == "Pod" and d["apiVersion"] == "v1"
+    assert d["spec"]["nodeSelector"] == {"disk": "ssd"}
+    assert d["spec"]["containers"][0]["resources"]["requests"]["cpu"] == "100m"
+    assert d["spec"]["containers"][0]["ports"][0]["hostPort"] == 8080
+    na = d["spec"]["affinity"]["nodeAffinity"]
+    assert na["requiredDuringSchedulingIgnoredDuringExecution"]["nodeSelectorTerms"][0][
+        "matchExpressions"][0]["operator"] == "In"
+    back = scheme.decode(d)
+    assert back == pod
+
+
+def test_omitempty():
+    d = to_dict(api.Pod(metadata=api.ObjectMeta(name="x", namespace="ns")))
+    assert "status" not in d
+    assert "labels" not in d["metadata"]
+    assert d["metadata"] == {"name": "x", "namespace": "ns"}
+
+
+def test_unknown_fields_ignored():
+    pod = from_dict(api.Pod, {"metadata": {"name": "a", "namespace": "b",
+                                           "futureField": 42}})
+    assert pod.metadata.name == "a"
+
+
+def test_deep_copy_isolation():
+    pod = mk_pod()
+    cp = deep_copy(pod)
+    assert cp == pod
+    cp.metadata.labels["app"] = "changed"
+    assert pod.metadata.labels["app"] == "web"
+
+
+def test_node_roundtrip():
+    node = api.Node(
+        metadata=api.ObjectMeta(name="n1", labels={api.LABEL_ZONE: "us-a"}),
+        spec=api.NodeSpec(unschedulable=True,
+                          taints=[api.Taint(key="dedicated", value="ml", effect="NoSchedule")]),
+        status=api.NodeStatus(
+            capacity={"cpu": "4", "memory": "32Gi", "pods": "110"},
+            allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+    assert scheme.decode(scheme.encode(node)) == node
+    alloc = api.node_allocatable(node)
+    assert alloc["cpu"] == 4000
+    assert alloc["memory"] == 32 * 2**30
+    assert alloc["pods"] == 110
+
+
+def test_pod_resource_request():
+    req = api.pod_resource_request(mk_pod())
+    assert req["cpu"] == 100
+    assert req["memory"] == 500 * 2**20
+
+
+def test_toleration_tolerates():
+    t_no = api.Taint(key="k", value="v", effect="NoSchedule")
+    assert api.Toleration(key="k", operator="Exists").tolerates(t_no)
+    assert api.Toleration(key="k", value="v").tolerates(t_no)  # default op Equal
+    assert not api.Toleration(key="k", value="other").tolerates(t_no)
+    assert not api.Toleration(key="other", operator="Exists").tolerates(t_no)
+    assert not api.Toleration(key="k", operator="Exists",
+                              effect="PreferNoSchedule").tolerates(t_no)
+    assert api.Toleration(key="k", operator="Exists", effect="").tolerates(t_no)
+    # empty key + Exists is the tolerate-everything wildcard
+    assert api.Toleration(key="", operator="Exists").tolerates(t_no)
+    assert api.Toleration(key="", operator="Exists").tolerates(
+        api.Taint(key="anything", value="x", effect="NoSchedule"))
+
+
+def test_scheduler_name_annotation_fallback():
+    pod = mk_pod()
+    assert api.get_pod_scheduler_name(pod) == api.DEFAULT_SCHEDULER_NAME
+    pod.metadata.annotations = {api.ANN_SCHEDULER_NAME: "tpu-scheduler"}
+    assert api.get_pod_scheduler_name(pod) == "tpu-scheduler"
+    pod.spec.scheduler_name = "explicit"
+    assert api.get_pod_scheduler_name(pod) == "explicit"
+
+
+def test_object_fields():
+    pod = mk_pod()
+    f = api.object_fields(pod)
+    assert f["spec.nodeName"] == "" and f["metadata.name"] == "web-1"
+    pod.spec.node_name = "n1"
+    assert api.object_fields(pod)["spec.nodeName"] == "n1"
+
+
+class TestValidation:
+    def test_valid_pod(self):
+        validate(mk_pod())
+
+    def test_pod_no_containers(self):
+        pod = api.Pod(metadata=api.ObjectMeta(name="x", namespace="d"), spec=api.PodSpec())
+        with pytest.raises(ValidationError, match="containers"):
+            validate(pod)
+
+    def test_bad_name(self):
+        pod = mk_pod()
+        pod.metadata.name = "Not_A_DNS_Name!"
+        with pytest.raises(ValidationError, match="DNS-1123"):
+            validate(pod)
+
+    def test_missing_namespace(self):
+        pod = mk_pod()
+        pod.metadata.namespace = ""
+        with pytest.raises(ValidationError, match="namespace"):
+            validate(pod)
+
+    def test_node_cluster_scoped(self):
+        node = api.Node(metadata=api.ObjectMeta(name="n1", namespace="oops"))
+        with pytest.raises(ValidationError, match="cluster-scoped"):
+            validate(node)
+
+    def test_bad_quantity(self):
+        pod = mk_pod()
+        pod.spec.containers[0].resources.requests = {"cpu": "lots"}
+        with pytest.raises(ValidationError, match="invalid quantity"):
+            validate(pod)
+
+    def test_negative_fractional_quantity(self):
+        # ceil(-0.1) == 0 must not mask the negative sign
+        pod = mk_pod()
+        pod.spec.containers[0].resources.requests = {"cpu": "-100m"}
+        with pytest.raises(ValidationError, match="non-negative"):
+            validate(pod)
+
+    def test_infinite_quantity(self):
+        pod = mk_pod()
+        pod.spec.containers[0].resources.requests = {"cpu": "inf"}
+        with pytest.raises(ValidationError, match="invalid quantity"):
+            validate(pod)
+
+    def test_uppercase_name_rejected(self):
+        pod = mk_pod()
+        pod.metadata.name = "WEB-1"
+        with pytest.raises(ValidationError, match="DNS-1123"):
+            validate(pod)
+
+    def test_generate_name_trailing_dash(self):
+        pod = mk_pod()
+        pod.metadata.name = ""
+        pod.metadata.generate_name = "web-"
+        validate(pod)  # prefix form must be accepted
+
+    def test_binding(self):
+        b = api.Binding(metadata=api.ObjectMeta(name="p", namespace="d"),
+                        target=api.ObjectReference(kind="Node", name="n1"))
+        validate(b)
+        with pytest.raises(ValidationError, match="target.name"):
+            validate(api.Binding(target=api.ObjectReference(kind="Node")))
+
+    def test_rc_selector_template_mismatch(self):
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc", namespace="d"),
+            spec=api.ReplicationControllerSpec(
+                replicas=3, selector={"app": "x"},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "y"}))))
+        with pytest.raises(ValidationError, match="satisfy selector"):
+            validate(rc)
