@@ -1,0 +1,8 @@
+"""L2 API server: REST over HTTP with LIST/WATCH streaming.
+
+Parity target: reference pkg/apiserver (api_installer.go route generation,
+resthandler.go, watch.go chunked streaming) + pkg/genericapiserver (serving
+stack) + pkg/master (resource composition).
+"""
+
+from kubernetes_tpu.apiserver.server import APIServer

@@ -1,0 +1,314 @@
+"""The API server.
+
+Route shapes (reference pkg/apiserver/api_installer.go:169
+registerResourceHandlers):
+
+  GET    /api/v1/{resource}                       cluster list / all-ns list
+  GET    /api/v1/{resource}?watch=true            watch stream (NDJSON frames)
+  GET    /api/v1/namespaces/{ns}/{resource}
+  POST   /api/v1/namespaces/{ns}/{resource}
+  GET    /api/v1/namespaces/{ns}/{resource}/{name}
+  PUT    /api/v1/namespaces/{ns}/{resource}/{name}
+  DELETE /api/v1/namespaces/{ns}/{resource}/{name}
+  PUT    /api/v1/namespaces/{ns}/pods/{name}/status
+  POST   /api/v1/namespaces/{ns}/bindings         (+ pods/{name}/binding)
+  GET    /healthz, /version, /metrics
+
+Watch responses stream newline-delimited JSON `{"type": ..., "object": ...}`
+frames over chunked transfer encoding, exactly the reference's
+watchjson format (pkg/apiserver/watch.go:64 serveWatch); `410 Gone` when the
+requested resourceVersion predates the store's retained window, which tells
+the Reflector to re-LIST (reflector.go:252).
+
+Built on ThreadingHTTPServer: one thread per connection, which is the
+idiomatic Python analogue of the reference's goroutine-per-request model.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu.api import fields as fieldsel
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import scheme, to_dict
+from kubernetes_tpu.registry.generic import (
+    RESOURCES, Registry, RegistryError, bad_request,
+)
+from kubernetes_tpu.storage import TooOldResourceVersion
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+_PATH = re.compile(
+    r"^/api/v1"
+    r"(?:/namespaces/(?P<ns>[a-z0-9-]+))?"
+    r"/(?P<resource>[a-z]+)"
+    r"(?:/(?P<name>[A-Za-z0-9._-]+))?"
+    r"(?:/(?P<sub>status|binding))?$"
+)
+
+
+class APIServer:
+    """In-process API server wrapping a Registry. `start()` binds a real
+    socket (port 0 = ephemeral); tests may also call `handle_*` style methods
+    through the Registry directly."""
+
+    def __init__(self, registry: Optional[Registry] = None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.registry = registry or Registry()
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "server not started"
+        return self._httpd.server_address[1]
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self):
+        registry = self.registry
+        outer = self
+
+        class Handler(_Handler):
+            pass
+
+        Handler.registry = registry
+        Handler.server_ref = outer
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="apiserver", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: Registry = None  # set per-server subclass
+    server_ref: APIServer = None
+    protocol_version = "HTTP/1.1"
+
+    # silence per-request stderr logging
+    def log_message(self, fmt, *args):
+        pass
+
+    # --- helpers -------------------------------------------------------------
+
+    def _send_json(self, code: int, payload: dict):
+        body = json.dumps(payload, separators=(",", ":")).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_status(self, code: int, reason: str, message: str):
+        self._send_json(code, {
+            "kind": "Status", "apiVersion": "v1",
+            "status": "Failure" if code >= 400 else "Success",
+            "reason": reason, "message": message, "code": code,
+        })
+
+    def _send_obj(self, obj, code: int = 200):
+        self._send_json(code, scheme.encode(obj))
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise bad_request(f"invalid JSON body: {e}") from None
+
+    # --- dispatch ------------------------------------------------------------
+
+    def _route(self, method: str):
+        with METRICS.time("apiserver_request_seconds", verb=method):
+            try:
+                self._route_inner(method)
+            except RegistryError as e:
+                self._send_status(e.code, e.reason, e.message)
+            except TooOldResourceVersion as e:
+                self._send_status(410, "Expired", str(e))
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # HandleCrash equivalent
+                import traceback
+                traceback.print_exc()
+                try:
+                    self._send_status(500, "InternalError", f"{type(e).__name__}: {e}")
+                except Exception:
+                    pass
+
+    def _route_inner(self, method: str):
+        url = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+
+        if url.path in ("/healthz", "/healthz/ping"):
+            return self._send_plain(200, b"ok")
+        if url.path == "/version":
+            return self._send_json(200, {"major": "0", "minor": "1",
+                                         "gitVersion": "kubernetes-tpu-0.1"})
+        if url.path == "/metrics":
+            return self._send_plain(200, METRICS.render().encode())
+
+        m = _PATH.match(url.path)
+        if not m:
+            return self._send_status(404, "NotFound", f"unknown path {url.path}")
+        ns = m.group("ns") or ""
+        resource = m.group("resource")
+        name = m.group("name")
+        sub = m.group("sub")
+
+        # "bindings" is a virtual write-only resource backed by the pod
+        # registry (reference BindingREST)
+        if resource == "bindings" and method == "POST":
+            return self._serve_binding(ns)
+        if resource not in RESOURCES:
+            return self._send_status(404, "NotFound", f"unknown resource {resource!r}")
+
+        if method == "GET" and not name:
+            if q.get("watch") in ("true", "1"):
+                return self._serve_watch(resource, ns, q)
+            return self._serve_list(resource, ns, q)
+        if method == "GET":
+            return self._send_obj(self.registry.get(resource, name, ns))
+        if method == "POST" and not name:
+            obj = scheme.decode_into(RESOURCES[resource].cls, self._read_body())
+            created = self.registry.create(resource, obj, namespace=ns)
+            return self._send_obj(created, 201)
+        if method == "POST" and sub == "binding":
+            return self._serve_binding(ns, pod_name=name)
+        if method == "PUT" and name:
+            obj = scheme.decode_into(RESOURCES[resource].cls, self._read_body())
+            self._check_body_matches_url(obj, name, ns)
+            if sub == "status":
+                return self._send_obj(self.registry.update_status(resource, obj, ns))
+            return self._send_obj(self.registry.update(resource, obj, namespace=ns))
+        if method == "DELETE" and name:
+            return self._send_obj(self.registry.delete(resource, name, ns))
+        return self._send_status(405, "MethodNotAllowed",
+                                 f"{method} not supported here")
+
+    def _check_body_matches_url(self, obj, name: str, ns: str):
+        """The reference apiserver rejects name/namespace mismatches between
+        the URL and body metadata with 400 (resthandler.go update path)."""
+        meta = getattr(obj, "metadata", None)
+        body_name = meta.name if meta else ""
+        body_ns = meta.namespace if meta else ""
+        if body_name and body_name != name:
+            raise bad_request(f"metadata.name {body_name!r} does not match URL name {name!r}")
+        if ns and body_ns and body_ns != ns:
+            raise bad_request(f"metadata.namespace {body_ns!r} does not match URL namespace {ns!r}")
+        if meta:
+            meta.name = meta.name or name
+            meta.namespace = meta.namespace or ns
+
+    def _send_plain(self, code: int, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # --- collection handlers -------------------------------------------------
+
+    def _selectors(self, q):
+        lsel = labelsel.parse_selector(q.get("labelSelector"))
+        fsel = fieldsel.parse_field_selector(q.get("fieldSelector"))
+        return lsel, fsel
+
+    def _serve_list(self, resource, ns, q):
+        lsel, fsel = self._selectors(q)
+        items, rv = self.registry.list(resource, ns, lsel, fsel)
+        rd = RESOURCES[resource]
+        self._send_json(200, {
+            "kind": rd.list_kind, "apiVersion": rd.api_version,
+            "metadata": {"resourceVersion": str(rv)},
+            "items": [to_dict(o) for o in items],
+        })
+
+    def _serve_binding(self, ns, pod_name: Optional[str] = None):
+        body = self._read_body()
+        binding = scheme.decode_into(api.Binding, body)
+        if pod_name and (binding.metadata is None or not binding.metadata.name):
+            binding.metadata = binding.metadata or api.ObjectMeta()
+            binding.metadata.name = pod_name
+        self.registry.bind_pod(binding, ns or "default")
+        self._send_status(201, "Created", "binding created")
+
+    def _serve_watch(self, resource, ns, q):
+        lsel, fsel = self._selectors(q)
+        since = q.get("resourceVersion")
+        try:
+            since_rv = int(since) if since not in (None, "") else None
+        except ValueError:
+            raise bad_request(f"invalid resourceVersion: {since!r}") from None
+        watcher = self.registry.watch(resource, ns, since_rv=since_rv)
+        rd = RESOURCES[resource]
+        METRICS.inc("apiserver_watch_streams", resource=resource)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while True:
+                ev = watcher.next(timeout=30.0)
+                if ev is None:
+                    # heartbeat: a blank line (clients skip it) so a dead TCP
+                    # peer raises BrokenPipe and we reclaim thread + watcher
+                    self._write_chunk(b"\n")
+                    continue
+                obj = self.registry._decode(rd, ev.obj, ev.rv)
+                if not Registry._matches(obj, lsel, fsel):
+                    continue
+                frame = json.dumps({"type": ev.type,
+                                    "object": scheme.encode(obj)},
+                                   separators=(",", ":")).encode() + b"\n"
+                self._write_chunk(frame)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            watcher.stop()
+            try:
+                self._write_chunk(b"")  # terminal chunk
+            except OSError:
+                pass
+
+    def _write_chunk(self, data: bytes):
+        if data:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        else:
+            self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+
+    # --- HTTP verbs ----------------------------------------------------------
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_PUT(self):
+        self._route("PUT")
+
+    def do_DELETE(self):
+        self._route("DELETE")

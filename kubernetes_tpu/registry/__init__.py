@@ -1,0 +1,9 @@
+"""L1 resource registries: generic REST store + per-resource strategies.
+
+Parity target: reference pkg/registry/generic/registry/store.go (the
+templated Store every resource instantiates) and the per-resource strategy
+packages (pkg/registry/pod, pkg/registry/node, ...), including the pod
+BindingREST (pkg/registry/pod/etcd/etcd.go:118-189).
+"""
+
+from kubernetes_tpu.registry.generic import ResourceDef, Registry, RESOURCES

@@ -1,0 +1,360 @@
+"""Generic registry store and the built-in resource table.
+
+The reference instantiates registry.Store per resource with strategy hooks
+(pkg/registry/generic/registry/store.go:65-105); here ResourceDef carries the
+same knobs (key layout, validation, create/update preparation, selectable
+fields) and Registry executes CRUD against storage.MemStore, returning typed
+objects. The pod binding subresource lives here too: a guaranteed_update that
+sets spec.nodeName iff empty and flips the PodScheduled condition atomically
+(reference assignPod/setPodHostAndAnnotations, pkg/registry/pod/etcd/etcd.go:
+146-189) — the scheduler's single write.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, Dict, List, Optional, Tuple, Type
+
+from kubernetes_tpu.api import fields as fieldsel
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api import validation
+from kubernetes_tpu.api.serialization import from_dict, scheme, to_dict
+from kubernetes_tpu.storage import Conflict, KeyExists, KeyNotFound, MemStore
+
+
+def _now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+@dataclass
+class ResourceDef:
+    """Everything the generic store needs to serve one resource."""
+
+    name: str                 # plural, e.g. "pods"
+    kind: str                 # "Pod"
+    cls: Type
+    namespaced: bool = True
+    list_kind: str = ""       # "PodList"
+    api_version: str = "v1"
+    validator: Optional[Callable] = None
+    prepare_for_create: Optional[Callable] = None  # (obj) -> None, mutate
+    prepare_for_update: Optional[Callable] = None  # (new, old) -> None
+
+    def __post_init__(self):
+        if not self.list_kind:
+            self.list_kind = self.kind + "List"
+
+    def key(self, namespace: str, name: str) -> str:
+        if self.namespaced:
+            return f"/{self.name}/{namespace}/{name}"
+        return f"/{self.name}/{name}"
+
+    def prefix(self, namespace: str = "") -> str:
+        if self.namespaced and namespace:
+            return f"/{self.name}/{namespace}/"
+        return f"/{self.name}/"
+
+
+class RegistryError(Exception):
+    def __init__(self, code: int, reason: str, message: str):
+        self.code = code
+        self.reason = reason
+        self.message = message
+        super().__init__(message)
+
+
+def not_found(kind, name):
+    return RegistryError(404, "NotFound", f'{kind} "{name}" not found')
+
+
+def already_exists(kind, name):
+    return RegistryError(409, "AlreadyExists", f'{kind} "{name}" already exists')
+
+
+def conflict(kind, name, msg):
+    return RegistryError(409, "Conflict", f'{kind} "{name}": {msg}')
+
+
+def invalid(msg):
+    return RegistryError(422, "Invalid", msg)
+
+
+def bad_request(msg):
+    return RegistryError(400, "BadRequest", msg)
+
+
+_uid_lock = threading.Lock()
+_uid_counter = [0]
+
+
+def _new_uid() -> str:
+    with _uid_lock:
+        _uid_counter[0] += 1
+        return f"uid-{_uid_counter[0]:08x}"
+
+
+def _pod_prepare_create(pod: api.Pod):
+    if pod.status is None:
+        pod.status = api.PodStatus()
+    if not pod.status.phase:
+        pod.status.phase = api.POD_PENDING
+    # nodeName is only settable via /bindings (reference pod strategy
+    # PrepareForCreate resets Status; binding sets the host)
+
+
+def _pod_prepare_update(new: api.Pod, old: api.Pod):
+    # spec.nodeName is immutable once set, except "" -> value via binding
+    if old.spec and old.spec.node_name and new.spec and new.spec.node_name != old.spec.node_name:
+        raise invalid("spec.nodeName: field is immutable")
+
+
+def _event_prepare_create(ev: api.Event):
+    if not ev.first_timestamp:
+        ev.first_timestamp = _now_iso()
+    if not ev.last_timestamp:
+        ev.last_timestamp = ev.first_timestamp
+    if not ev.count:
+        ev.count = 1
+
+
+RESOURCES: Dict[str, ResourceDef] = {}
+
+
+def _register(rd: ResourceDef):
+    RESOURCES[rd.name] = rd
+    return rd
+
+
+_register(ResourceDef("pods", "Pod", api.Pod, validator=validation.validate_pod,
+                      prepare_for_create=_pod_prepare_create,
+                      prepare_for_update=_pod_prepare_update))
+_register(ResourceDef("nodes", "Node", api.Node, namespaced=False,
+                      validator=validation.validate_node))
+_register(ResourceDef("services", "Service", api.Service,
+                      validator=validation.validate_service))
+_register(ResourceDef("endpoints", "Endpoints", api.Endpoints,
+                      list_kind="EndpointsList"))
+_register(ResourceDef("replicationcontrollers", "ReplicationController",
+                      api.ReplicationController,
+                      validator=validation.validate_replication_controller))
+_register(ResourceDef("replicasets", "ReplicaSet", api.ReplicaSet,
+                      api_version="extensions/v1beta1"))
+_register(ResourceDef("namespaces", "Namespace", api.Namespace, namespaced=False,
+                      validator=validation.validate_namespace))
+_register(ResourceDef("events", "Event", api.Event,
+                      prepare_for_create=_event_prepare_create))
+_register(ResourceDef("persistentvolumes", "PersistentVolume",
+                      api.PersistentVolume, namespaced=False))
+_register(ResourceDef("persistentvolumeclaims", "PersistentVolumeClaim",
+                      api.PersistentVolumeClaim))
+
+
+class Registry:
+    """CRUD over typed objects, backed by one MemStore."""
+
+    def __init__(self, store: Optional[MemStore] = None):
+        self.store = store or MemStore()
+
+    def _def(self, resource: str) -> ResourceDef:
+        try:
+            return RESOURCES[resource]
+        except KeyError:
+            raise not_found("resource", resource) from None
+
+    # --- CRUD ----------------------------------------------------------------
+
+    def create(self, resource: str, obj, namespace: str = ""):
+        rd = self._def(resource)
+        if not isinstance(obj, rd.cls):
+            raise bad_request(f"expected {rd.kind}, got {type(obj).__name__}")
+        meta = obj.metadata or api.ObjectMeta()
+        obj.metadata = meta
+        if rd.namespaced:
+            meta.namespace = meta.namespace or namespace or "default"
+        if not meta.name and meta.generate_name:
+            meta.name = meta.generate_name + _new_uid()[4:]
+        if rd.prepare_for_create:
+            rd.prepare_for_create(obj)
+        if rd.validator:
+            try:
+                rd.validator(obj)
+            except validation.ValidationError as e:
+                raise invalid(str(e)) from None
+        meta.uid = meta.uid or _new_uid()
+        meta.creation_timestamp = meta.creation_timestamp or _now_iso()
+        key = rd.key(meta.namespace, meta.name)
+        try:
+            rv = self.store.create(key, to_dict(obj))
+        except KeyExists:
+            raise already_exists(rd.kind, meta.name) from None
+        meta.resource_version = str(rv)
+        return obj
+
+    def get(self, resource: str, name: str, namespace: str = ""):
+        rd = self._def(resource)
+        try:
+            d, rv = self.store.get(rd.key(namespace, name))
+        except KeyNotFound:
+            raise not_found(rd.kind, name) from None
+        return self._decode(rd, d, rv)
+
+    def list(self, resource: str, namespace: str = "",
+             label_selector: Optional[labelsel.Selector] = None,
+             field_selector: Optional[fieldsel.FieldSelector] = None
+             ) -> Tuple[list, int]:
+        rd = self._def(resource)
+        raw, rv = self.store.list(rd.prefix(namespace))
+        out = []
+        for d, item_rv in raw:
+            obj = self._decode(rd, d, item_rv)
+            if self._matches(obj, label_selector, field_selector):
+                out.append(obj)
+        return out, rv
+
+    def update(self, resource: str, obj, namespace: str = ""):
+        rd = self._def(resource)
+        meta = obj.metadata or api.ObjectMeta()
+        key = rd.key(meta.namespace or namespace, meta.name)
+        expect = int(meta.resource_version) if meta.resource_version else None
+        try:
+            old_d, old_rv = self.store.get(key)
+        except KeyNotFound:
+            raise not_found(rd.kind, meta.name) from None
+        old = self._decode(rd, old_d, old_rv)
+        if rd.prepare_for_update:
+            rd.prepare_for_update(obj, old)
+        if rd.validator:
+            try:
+                rd.validator(obj)
+            except validation.ValidationError as e:
+                raise invalid(str(e)) from None
+        # preserve server-managed fields
+        meta.uid = old.metadata.uid
+        meta.creation_timestamp = old.metadata.creation_timestamp
+        try:
+            rv = self.store.update(key, to_dict(obj), expect_rv=expect)
+        except Conflict as e:
+            raise conflict(rd.kind, meta.name, str(e)) from None
+        meta.resource_version = str(rv)
+        return obj
+
+    def guaranteed_update(self, resource: str, name: str, namespace: str,
+                          fn: Callable):
+        """Typed CAS loop: fn(typed_obj) -> typed_obj or None (no-op)."""
+        rd = self._def(resource)
+
+        def raw_fn(d: dict):
+            obj = self._decode(rd, d, None)
+            new = fn(obj)
+            return None if new is None else to_dict(new)
+
+        try:
+            d, rv = self.store.guaranteed_update(rd.key(namespace, name), raw_fn)
+        except KeyNotFound:
+            raise not_found(rd.kind, name) from None
+        return self._decode(rd, d, rv)
+
+    def delete(self, resource: str, name: str, namespace: str = ""):
+        rd = self._def(resource)
+        try:
+            d, rv = self.store.delete(rd.key(namespace, name))
+        except KeyNotFound:
+            raise not_found(rd.kind, name) from None
+        return self._decode(rd, d, rv)
+
+    def watch(self, resource: str, namespace: str = "",
+              since_rv: Optional[int] = None):
+        rd = self._def(resource)
+        return self.store.watch(rd.prefix(namespace), since_rv)
+
+    # --- subresources --------------------------------------------------------
+
+    def bind_pod(self, binding: api.Binding, namespace: str) -> None:
+        """POST /bindings: atomically set pod.spec.nodeName iff empty and mark
+        PodScheduled=True (reference etcd.go:146-189)."""
+        try:
+            validation.validate_binding(binding)
+        except validation.ValidationError as e:
+            raise invalid(str(e)) from None
+        pod_name = binding.metadata.name if binding.metadata else ""
+        if not pod_name:
+            raise invalid("metadata.name (pod name) required")
+        node_name = binding.target.name
+
+        def assign(pod: api.Pod):
+            if pod.spec is None:
+                pod.spec = api.PodSpec()
+            if pod.spec.node_name and pod.spec.node_name != node_name:
+                raise conflict("Pod", pod_name,
+                               f"is already assigned to node {pod.spec.node_name!r}")
+            if pod.spec.node_name == node_name:
+                return None  # idempotent
+            pod.spec.node_name = node_name
+            if pod.status is None:
+                pod.status = api.PodStatus()
+            _set_pod_condition(pod, api.POD_SCHEDULED, api.CONDITION_TRUE, "", "")
+            return pod
+
+        self.guaranteed_update("pods", pod_name, namespace, assign)
+
+    def update_status(self, resource: str, obj, namespace: str = ""):
+        """PUT /{resource}/{name}/status — replaces only .status."""
+        rd = self._def(resource)
+        meta = obj.metadata or api.ObjectMeta()
+
+        def set_status(cur):
+            cur.status = obj.status
+            if rd.validator:
+                try:
+                    rd.validator(cur)
+                except validation.ValidationError as e:
+                    raise invalid(str(e)) from None
+            return cur
+
+        return self.guaranteed_update(resource, meta.name,
+                                      meta.namespace or namespace, set_status)
+
+    # --- helpers -------------------------------------------------------------
+
+    def _decode(self, rd: ResourceDef, d: dict, rv: Optional[int]):
+        obj = from_dict(rd.cls, d)
+        if rv is not None:
+            if obj.metadata is None:
+                obj.metadata = api.ObjectMeta()
+            obj.metadata.resource_version = str(rv)
+        return obj
+
+    @staticmethod
+    def _matches(obj, label_selector, field_selector) -> bool:
+        if label_selector is not None and not label_selector.empty():
+            lbls = (obj.metadata.labels or {}) if obj.metadata else {}
+            if not label_selector.matches(lbls):
+                return False
+        if field_selector is not None and not field_selector.empty():
+            if not field_selector.matches(api.object_fields(obj)):
+                return False
+        return True
+
+
+def _set_pod_condition(pod: api.Pod, ctype: str, status: str, reason: str,
+                       message: str):
+    """Idempotent condition upsert (reference api.UpdatePodCondition)."""
+    conds = list(pod.status.conditions or [])
+    for i, c in enumerate(conds):
+        if c.type == ctype:
+            if c.status == status and c.reason == reason:
+                return
+            conds[i] = api.PodCondition(type=ctype, status=status, reason=reason,
+                                        message=message,
+                                        last_transition_time=_now_iso())
+            pod.status.conditions = conds
+            return
+    conds.append(api.PodCondition(type=ctype, status=status, reason=reason,
+                                  message=message, last_transition_time=_now_iso()))
+    pod.status.conditions = conds
+
+
+set_pod_condition = _set_pod_condition

@@ -1,0 +1,15 @@
+"""L0 persistence: versioned, watchable KV store.
+
+Parity target: reference pkg/storage — storage.Interface
+(pkg/storage/interfaces.go:82-163: Create/Get/List/Delete/GuaranteedUpdate/
+Watch/WatchList) fused with the Cacher/watchCache fan-out layer
+(pkg/storage/cacher.go:73, watch_cache.go:64). The reference splits these
+because etcd is an external process; here the store is in-process, so the
+watch window is built in and every watcher is served from the same ring
+buffer that a separate cache would have maintained.
+"""
+
+from kubernetes_tpu.storage.store import (
+    Event, MemStore, StorageError, KeyExists, KeyNotFound, Conflict,
+    TooOldResourceVersion, ADDED, MODIFIED, DELETED,
+)

@@ -1,0 +1,250 @@
+"""In-process versioned KV store with watch.
+
+Semantics mirrored from the reference's storage contract:
+
+- Every write bumps a single monotonically-increasing resourceVersion
+  (etcd modifiedIndex semantics, pkg/storage/etcd/api_object_versioner.go).
+- `guaranteed_update` is the CAS retry loop (GuaranteedUpdate,
+  pkg/storage/interfaces.go:130-163) — the cluster's only transaction
+  primitive; the binding subresource and every status update ride on it.
+- `watch(prefix, since_rv)` replays buffered events with rv > since_rv then
+  streams live; a since_rv older than the retained window raises
+  TooOldResourceVersion, which the API server surfaces as HTTP 410 Gone and
+  clients answer with a re-LIST (the Reflector contract,
+  pkg/client/cache/reflector.go:252).
+- Values are plain JSON-ready dicts (the storage layer is codec-agnostic,
+  like etcd storing bytes); typed encode/decode happens in the registry.
+
+Thread-safe; watchers receive events on unbounded queues so a slow watcher
+cannot block writers (the reference drops slow watchers instead — we keep
+them and let the queue grow, acceptable in-process).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+ERROR = "ERROR"
+
+
+class StorageError(Exception):
+    pass
+
+
+class KeyExists(StorageError):
+    pass
+
+
+class KeyNotFound(StorageError):
+    pass
+
+
+class Conflict(StorageError):
+    """CAS failure: resourceVersion precondition not met."""
+
+
+class TooOldResourceVersion(StorageError):
+    """Requested watch start is before the retained event window (HTTP 410)."""
+
+    def __init__(self, requested: int, oldest: int):
+        self.requested = requested
+        self.oldest = oldest
+        super().__init__(f"resourceVersion {requested} is too old (oldest retained: {oldest})")
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str  # ADDED | MODIFIED | DELETED
+    key: str
+    rv: int
+    obj: dict  # for DELETED, the last state of the object
+
+
+def _copy(obj: dict) -> dict:
+    # values are JSON-shaped; json roundtrip is the fastest general deep copy
+    return json.loads(json.dumps(obj))
+
+
+class _Watcher:
+    """One watch stream. Iterate to consume events; `stop()` to cancel."""
+
+    def __init__(self, store: "MemStore", prefix: str, pending: List[Event]):
+        import queue
+
+        self._store = store
+        self.prefix = prefix
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._stopped = False
+        for ev in pending:
+            self._q.put(ev)
+
+    def _deliver(self, ev: Event):
+        if not self._stopped and ev.key.startswith(self.prefix):
+            self._q.put(ev)
+
+    def stop(self):
+        if not self._stopped:
+            self._stopped = True
+            self._store._remove_watcher(self)
+            self._q.put(None)  # unblock consumers
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Event:
+        ev = self._q.get()
+        if ev is None:
+            raise StopIteration
+        return ev
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Blocking pop with timeout; None on timeout or stop."""
+        import queue
+
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return ev
+
+
+class MemStore:
+    """The versioned KV + watch window. Keys are '/'-separated paths like
+    '/pods/default/web-1' (reference key layout '/registry/pods/<ns>/<name>')."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.RLock()
+        self._data: Dict[str, Tuple[dict, int]] = {}
+        self._rv = 0
+        self._events: deque = deque(maxlen=window)
+        self._watchers: List[_Watcher] = []
+
+    # --- reads ---------------------------------------------------------------
+
+    @property
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def get(self, key: str) -> Tuple[dict, int]:
+        with self._lock:
+            try:
+                obj, rv = self._data[key]
+            except KeyError:
+                raise KeyNotFound(key) from None
+            return _copy(obj), rv
+
+    def list(self, prefix: str) -> Tuple[List[Tuple[dict, int]], int]:
+        """All objects under prefix plus the store rv at snapshot time."""
+        with self._lock:
+            items = [(_copy(o), rv) for k, (o, rv) in sorted(self._data.items())
+                     if k.startswith(prefix)]
+            return items, self._rv
+
+    def count(self, prefix: str) -> int:
+        with self._lock:
+            return sum(1 for k in self._data if k.startswith(prefix))
+
+    # --- writes --------------------------------------------------------------
+
+    def create(self, key: str, obj: dict) -> int:
+        with self._lock:
+            if key in self._data:
+                raise KeyExists(key)
+            self._rv += 1
+            obj = _copy(obj)
+            self._data[key] = (obj, self._rv)
+            self._publish(Event(ADDED, key, self._rv, obj))
+            return self._rv
+
+    def update(self, key: str, obj: dict, expect_rv: Optional[int] = None) -> int:
+        """Unconditional (expect_rv=None) or CAS update."""
+        with self._lock:
+            if key not in self._data:
+                raise KeyNotFound(key)
+            _, cur_rv = self._data[key]
+            if expect_rv is not None and expect_rv != cur_rv:
+                raise Conflict(f"{key}: rv {expect_rv} != current {cur_rv}")
+            self._rv += 1
+            obj = _copy(obj)
+            self._data[key] = (obj, self._rv)
+            self._publish(Event(MODIFIED, key, self._rv, obj))
+            return self._rv
+
+    def guaranteed_update(self, key: str,
+                          fn: Callable[[dict], Optional[dict]],
+                          max_retries: int = 10) -> Tuple[dict, int]:
+        """CAS retry loop: fn(current) -> new object (or raise to abort).
+        fn returning None aborts without error (no-op). In-process the lock
+        makes one attempt sufficient, but the retry structure is kept because
+        fn may observe state via other stores/side effects."""
+        for _ in range(max_retries):
+            obj, rv = self.get(key)
+            new = fn(obj)
+            if new is None:
+                return obj, rv
+            try:
+                new_rv = self.update(key, new, expect_rv=rv)
+                return _copy(new), new_rv
+            except Conflict:
+                continue
+        raise Conflict(f"{key}: too much contention")
+
+    def delete(self, key: str, expect_rv: Optional[int] = None) -> Tuple[dict, int]:
+        with self._lock:
+            if key not in self._data:
+                raise KeyNotFound(key)
+            obj, cur_rv = self._data[key]
+            if expect_rv is not None and expect_rv != cur_rv:
+                raise Conflict(f"{key}: rv {expect_rv} != current {cur_rv}")
+            self._rv += 1
+            del self._data[key]
+            self._publish(Event(DELETED, key, self._rv, obj))
+            return _copy(obj), self._rv
+
+    # --- watch ---------------------------------------------------------------
+
+    def watch(self, prefix: str, since_rv: Optional[int] = None) -> _Watcher:
+        """Stream events for keys under prefix. since_rv=None starts from now;
+        otherwise replays retained events with rv > since_rv first.
+
+        since_rv == 0 means "from the beginning of time", which is only valid
+        while the window still reaches back to the first event."""
+        with self._lock:
+            pending: List[Event] = []
+            if since_rv is not None and since_rv < self._rv:
+                oldest_buffered = self._events[0].rv if self._events else self._rv + 1
+                # we can serve since_rv if every event after it is retained
+                if since_rv + 1 < oldest_buffered:
+                    raise TooOldResourceVersion(since_rv, oldest_buffered)
+                pending = [e for e in self._events
+                           if e.rv > since_rv and e.key.startswith(prefix)]
+            w = _Watcher(self, prefix, pending)
+            self._watchers.append(w)
+            return w
+
+    def _publish(self, ev: Event):
+        self._events.append(ev)
+        for w in list(self._watchers):
+            w._deliver(ev)
+
+    def _remove_watcher(self, w: _Watcher):
+        with self._lock:
+            try:
+                self._watchers.remove(w)
+            except ValueError:
+                pass
+
+    def compact(self, keep: int = 0):
+        """Drop retained events (forces laggy watchers to re-list) —
+        etcd3 compaction analogue (pkg/storage/etcd3/compact.go)."""
+        with self._lock:
+            while len(self._events) > keep:
+                self._events.popleft()

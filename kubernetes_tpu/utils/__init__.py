@@ -1,0 +1,5 @@
+"""Cross-cutting utilities.
+
+Parity target: reference pkg/util — workqueue (+Parallelize), flowcontrol
+(token bucket + backoff), wait (Until/Poll), clock injection, trace, metrics.
+"""

@@ -1,0 +1,136 @@
+"""Prometheus-style metrics: counters, gauges, histograms, text exposition.
+
+Parity target: reference's per-component prometheus registries
+(plugin/pkg/scheduler/metrics/metrics.go, pkg/apiserver/metrics) — exponential
+histogram buckets 1ms*2^k mirroring the scheduler latency histograms.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Tuple
+
+# 1ms * 2^k for k in 0..14 — the scheduler histogram bucket layout
+# (reference metrics.go:31-54)
+SCHEDULER_BUCKETS = tuple(0.001 * 2**k for k in range(15))
+
+
+def _label_key(labels: dict) -> Tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Histogram:
+    def __init__(self, name: str, buckets=SCHEDULER_BUCKETS):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple, list] = defaultdict(lambda: [0] * (len(self.buckets) + 1))
+        self._sums: Dict[Tuple, float] = defaultdict(float)
+        self._totals: Dict[Tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, **labels):
+        k = _label_key(labels)
+        counts = self._counts[k]
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+        self._sums[k] += value
+        self._totals[k] += 1
+
+    def quantile(self, q: float, **labels) -> float:
+        """Estimated quantile from bucket counts (upper bound of the bucket
+        containing the q-th observation)."""
+        k = _label_key(labels)
+        counts = self._counts.get(k)
+        total = self._totals.get(k, 0)
+        if not counts or not total:
+            return 0.0
+        target = q * total
+        seen = 0
+        for i, c in enumerate(counts[:-1]):
+            seen += c
+            if seen >= target:
+                return self.buckets[i]
+        return float("inf")
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[Tuple, float]] = defaultdict(lambda: defaultdict(float))
+        self._gauges: Dict[str, Dict[Tuple, float]] = defaultdict(dict)
+        self._histograms: Dict[str, Histogram] = {}
+
+    def inc(self, name: str, value: float = 1.0, **labels):
+        with self._lock:
+            self._counters[name][_label_key(labels)] += value
+
+    def set_gauge(self, name: str, value: float, **labels):
+        with self._lock:
+            self._gauges[name][_label_key(labels)] = value
+
+    def observe(self, name: str, value: float, buckets=SCHEDULER_BUCKETS, **labels):
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, buckets)
+            h.observe(value, **labels)
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    @contextmanager
+    def time(self, name: str, **labels):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0, **labels)
+
+    def counter_value(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get(name, {}).get(_label_key(labels), 0.0)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out = []
+        with self._lock:
+            for name, series in sorted(self._counters.items()):
+                out.append(f"# TYPE {name} counter")
+                for lk, v in sorted(series.items()):
+                    out.append(f"{name}{_fmt_labels(lk)} {v}")
+            for name, series in sorted(self._gauges.items()):
+                out.append(f"# TYPE {name} gauge")
+                for lk, v in sorted(series.items()):
+                    out.append(f"{name}{_fmt_labels(lk)} {v}")
+            for name, h in sorted(self._histograms.items()):
+                out.append(f"# TYPE {name} histogram")
+                for lk in h._totals:
+                    cum = 0
+                    for i, b in enumerate(h.buckets):
+                        cum += h._counts[lk][i]
+                        out.append(f'{name}_bucket{_fmt_labels(lk, le=b)} {cum}')
+                    out.append(f'{name}_bucket{_fmt_labels(lk, le="+Inf")} {h._totals[lk]}')
+                    out.append(f"{name}_sum{_fmt_labels(lk)} {h._sums[lk]}")
+                    out.append(f"{name}_count{_fmt_labels(lk)} {h._totals[lk]}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt_labels(lk: Tuple, **extra) -> str:
+    pairs = list(lk) + sorted(extra.items())
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,229 @@
+"""API server integration: CRUD, selectors, watch streaming, bindings over
+real HTTP sockets (the reference's httptest.Server pattern,
+test/integration/framework/master_utils.go)."""
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.apiserver import APIServer
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+def req(server, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path, body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = json.loads(resp.read() or b"{}")
+    conn.close()
+    return resp.status, data
+
+
+def mk_pod_body(name, ns="default", labels=None, cpu="100m"):
+    return scheme.encode(api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="pause",
+            resources=api.ResourceRequirements(requests={"cpu": cpu, "memory": "500Mi"}))])))
+
+
+class TestCRUD:
+    def test_create_get_list_delete(self, server):
+        code, created = req(server, "POST", "/api/v1/namespaces/default/pods",
+                            mk_pod_body("web-1", labels={"app": "web"}))
+        assert code == 201
+        assert created["metadata"]["uid"]
+        assert created["metadata"]["resourceVersion"]
+        assert created["status"]["phase"] == "Pending"
+
+        code, got = req(server, "GET", "/api/v1/namespaces/default/pods/web-1")
+        assert code == 200 and got["metadata"]["name"] == "web-1"
+
+        code, lst = req(server, "GET", "/api/v1/namespaces/default/pods")
+        assert code == 200 and lst["kind"] == "PodList" and len(lst["items"]) == 1
+        assert int(lst["metadata"]["resourceVersion"]) >= 1
+
+        code, _ = req(server, "DELETE", "/api/v1/namespaces/default/pods/web-1")
+        assert code == 200
+        code, _ = req(server, "GET", "/api/v1/namespaces/default/pods/web-1")
+        assert code == 404
+
+    def test_validation_422(self, server):
+        bad = {"kind": "Pod", "apiVersion": "v1",
+               "metadata": {"name": "x", "namespace": "default"},
+               "spec": {"containers": []}}
+        code, status = req(server, "POST", "/api/v1/namespaces/default/pods", bad)
+        assert code == 422 and status["reason"] == "Invalid"
+
+    def test_duplicate_409(self, server):
+        req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body("a"))
+        code, status = req(server, "POST", "/api/v1/namespaces/default/pods",
+                           mk_pod_body("a"))
+        assert code == 409 and status["reason"] == "AlreadyExists"
+
+    def test_cluster_scoped_nodes(self, server):
+        node = scheme.encode(api.Node(
+            metadata=api.ObjectMeta(name="n1", labels={"zone": "us-a"}),
+            status=api.NodeStatus(capacity={"cpu": "4", "memory": "8Gi", "pods": "110"})))
+        code, _ = req(server, "POST", "/api/v1/nodes", node)
+        assert code == 201
+        code, lst = req(server, "GET", "/api/v1/nodes")
+        assert code == 200 and len(lst["items"]) == 1
+
+    def test_update_conflict_on_stale_rv(self, server):
+        _, created = req(server, "POST", "/api/v1/namespaces/default/pods",
+                         mk_pod_body("a", labels={"v": "1"}))
+        stale = dict(created)
+        # first update succeeds
+        created["metadata"]["labels"] = {"v": "2"}
+        code, _ = req(server, "PUT", "/api/v1/namespaces/default/pods/a", created)
+        assert code == 200
+        # stale rv now conflicts
+        stale["metadata"]["labels"] = {"v": "3"}
+        code, status = req(server, "PUT", "/api/v1/namespaces/default/pods/a", stale)
+        assert code == 409 and status["reason"] == "Conflict"
+
+    def test_label_and_field_selectors(self, server):
+        req(server, "POST", "/api/v1/namespaces/default/pods",
+            mk_pod_body("w1", labels={"app": "web"}))
+        req(server, "POST", "/api/v1/namespaces/default/pods",
+            mk_pod_body("d1", labels={"app": "db"}))
+        code, lst = req(server, "GET",
+                        "/api/v1/namespaces/default/pods?labelSelector=app%3Dweb")
+        assert [i["metadata"]["name"] for i in lst["items"]] == ["w1"]
+        # unassigned-pod selector, the scheduler's ListWatch
+        code, lst = req(server, "GET",
+                        "/api/v1/pods?fieldSelector=spec.nodeName%3D")
+        assert len(lst["items"]) == 2
+
+    def test_status_subresource(self, server):
+        _, created = req(server, "POST", "/api/v1/namespaces/default/pods",
+                         mk_pod_body("a"))
+        created["status"] = {"phase": "Running"}
+        code, updated = req(server, "PUT",
+                            "/api/v1/namespaces/default/pods/a/status", created)
+        assert code == 200 and updated["status"]["phase"] == "Running"
+
+    def test_healthz_version(self, server):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().read() == b"ok"
+        conn.request("GET", "/version")
+        assert b"gitVersion" in conn.getresponse().read()
+        conn.request("GET", "/metrics")
+        assert b"apiserver_request_seconds" in conn.getresponse().read()
+        conn.close()
+
+
+class TestBinding:
+    def test_bind_sets_node_name_and_condition(self, server):
+        req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body("p1"))
+        binding = {"kind": "Binding", "apiVersion": "v1",
+                   "metadata": {"name": "p1", "namespace": "default"},
+                   "target": {"kind": "Node", "name": "n1"}}
+        code, _ = req(server, "POST", "/api/v1/namespaces/default/bindings", binding)
+        assert code == 201
+        _, pod = req(server, "GET", "/api/v1/namespaces/default/pods/p1")
+        assert pod["spec"]["nodeName"] == "n1"
+        conds = {c["type"]: c["status"] for c in pod["status"]["conditions"]}
+        assert conds["PodScheduled"] == "True"
+
+    def test_double_bind_conflicts(self, server):
+        req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body("p1"))
+        binding = {"kind": "Binding", "apiVersion": "v1",
+                   "metadata": {"name": "p1", "namespace": "default"},
+                   "target": {"kind": "Node", "name": "n1"}}
+        assert req(server, "POST", "/api/v1/namespaces/default/bindings", binding)[0] == 201
+        # same node again: idempotent success
+        assert req(server, "POST", "/api/v1/namespaces/default/bindings", binding)[0] == 201
+        binding["target"]["name"] = "n2"
+        code, status = req(server, "POST", "/api/v1/namespaces/default/bindings", binding)
+        assert code == 409
+
+    def test_pod_subresource_binding_route(self, server):
+        req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body("p2"))
+        binding = {"kind": "Binding", "apiVersion": "v1",
+                   "target": {"kind": "Node", "name": "n9"}}
+        code, _ = req(server, "POST",
+                      "/api/v1/namespaces/default/pods/p2/binding", binding)
+        assert code == 201
+        _, pod = req(server, "GET", "/api/v1/namespaces/default/pods/p2")
+        assert pod["spec"]["nodeName"] == "n9"
+
+    def test_bind_missing_pod_404(self, server):
+        binding = {"kind": "Binding", "apiVersion": "v1",
+                   "metadata": {"name": "ghost", "namespace": "default"},
+                   "target": {"kind": "Node", "name": "n1"}}
+        code, _ = req(server, "POST", "/api/v1/namespaces/default/bindings", binding)
+        assert code == 404
+
+
+class TestWatchHTTP:
+    def _open_watch(self, server, path):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        return conn, resp
+
+    def test_watch_streams_events(self, server):
+        _, lst = req(server, "GET", "/api/v1/pods")
+        rv = lst["metadata"]["resourceVersion"]
+        conn, resp = self._open_watch(
+            server, f"/api/v1/pods?watch=true&resourceVersion={rv}")
+
+        req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body("w1"))
+        line = resp.readline()
+        ev = json.loads(line)
+        assert ev["type"] == "ADDED"
+        assert ev["object"]["metadata"]["name"] == "w1"
+
+        req(server, "DELETE", "/api/v1/namespaces/default/pods/w1")
+        ev2 = json.loads(resp.readline())
+        assert ev2["type"] == "DELETED"
+        conn.close()
+
+    def test_watch_replays_from_rv(self, server):
+        req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body("old"))
+        conn, resp = self._open_watch(
+            server, "/api/v1/pods?watch=true&resourceVersion=0")
+        ev = json.loads(resp.readline())
+        assert ev["type"] == "ADDED" and ev["object"]["metadata"]["name"] == "old"
+        conn.close()
+
+    def test_watch_410_on_compacted_rv(self, server):
+        for i in range(3):
+            req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body(f"p{i}"))
+        server.registry.store.compact()
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        conn.request("GET", "/api/v1/pods?watch=true&resourceVersion=1")
+        resp = conn.getresponse()
+        assert resp.status == 410
+        conn.close()
+
+    def test_watch_field_selector_filters(self, server):
+        conn, resp = self._open_watch(
+            server, "/api/v1/pods?watch=true&fieldSelector=spec.nodeName%3Dn1")
+        req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body("unsched"))
+        req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body("sched"))
+        binding = {"kind": "Binding", "apiVersion": "v1",
+                   "metadata": {"name": "sched", "namespace": "default"},
+                   "target": {"kind": "Node", "name": "n1"}}
+        req(server, "POST", "/api/v1/namespaces/default/bindings", binding)
+        ev = json.loads(resp.readline())
+        # only the bound pod's MODIFIED event passes the filter
+        assert ev["object"]["metadata"]["name"] == "sched"
+        assert ev["object"]["spec"]["nodeName"] == "n1"
+        conn.close()

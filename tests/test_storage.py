@@ -1,0 +1,158 @@
+"""MemStore: versioning, CAS, watch window semantics (reference
+pkg/storage interfaces + watch cache behavior)."""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.storage import (
+    ADDED, DELETED, MODIFIED, Conflict, KeyExists, KeyNotFound, MemStore,
+    TooOldResourceVersion,
+)
+
+
+def test_create_get_versions():
+    s = MemStore()
+    rv1 = s.create("/pods/default/a", {"x": 1})
+    rv2 = s.create("/pods/default/b", {"x": 2})
+    assert rv2 > rv1
+    obj, rv = s.get("/pods/default/a")
+    assert obj == {"x": 1} and rv == rv1
+    with pytest.raises(KeyExists):
+        s.create("/pods/default/a", {})
+    with pytest.raises(KeyNotFound):
+        s.get("/missing")
+
+
+def test_returned_objects_are_copies():
+    s = MemStore()
+    s.create("/k", {"nested": {"a": 1}})
+    obj, _ = s.get("/k")
+    obj["nested"]["a"] = 99
+    assert s.get("/k")[0]["nested"]["a"] == 1
+
+
+def test_list_prefix_and_snapshot_rv():
+    s = MemStore()
+    s.create("/pods/ns1/a", {"n": "a"})
+    s.create("/pods/ns2/b", {"n": "b"})
+    s.create("/nodes/n1", {"n": "n1"})
+    items, rv = s.list("/pods/")
+    assert [o["n"] for o, _ in items] == ["a", "b"]
+    assert rv == s.current_rv
+    items, _ = s.list("/pods/ns1/")
+    assert len(items) == 1
+
+
+def test_cas_update():
+    s = MemStore()
+    rv = s.create("/k", {"v": 0})
+    rv2 = s.update("/k", {"v": 1}, expect_rv=rv)
+    with pytest.raises(Conflict):
+        s.update("/k", {"v": 2}, expect_rv=rv)  # stale
+    assert s.get("/k")[0] == {"v": 1}
+    s.update("/k", {"v": 3})  # unconditional
+    assert s.get("/k")[0] == {"v": 3}
+
+
+def test_guaranteed_update():
+    s = MemStore()
+    s.create("/k", {"v": 0})
+    obj, rv = s.guaranteed_update("/k", lambda o: {**o, "v": o["v"] + 1})
+    assert obj["v"] == 1
+    # fn returning None = no-op
+    obj2, rv2 = s.guaranteed_update("/k", lambda o: None)
+    assert obj2["v"] == 1 and rv2 == rv
+
+
+def test_guaranteed_update_concurrent():
+    s = MemStore()
+    s.create("/counter", {"v": 0})
+    n_threads, n_incr = 8, 50
+
+    def work():
+        for _ in range(n_incr):
+            s.guaranteed_update("/counter", lambda o: {**o, "v": o["v"] + 1})
+
+    ts = [threading.Thread(target=work) for _ in range(n_threads)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert s.get("/counter")[0]["v"] == n_threads * n_incr
+
+
+def test_delete_and_event():
+    s = MemStore()
+    s.create("/k", {"v": 1})
+    w = s.watch("/", since_rv=0)
+    obj, rv = s.delete("/k")
+    assert obj == {"v": 1}
+    evs = [w.next(timeout=1) for _ in range(2)]
+    assert [e.type for e in evs] == [ADDED, DELETED]
+    assert evs[1].obj == {"v": 1}  # deleted events carry final state
+    w.stop()
+
+
+class TestWatch:
+    def test_live_stream(self):
+        s = MemStore()
+        w = s.watch("/pods/")
+        s.create("/pods/ns/a", {"n": "a"})
+        s.update("/pods/ns/a", {"n": "a2"})
+        s.create("/nodes/x", {})  # outside prefix: not delivered
+        e1, e2 = w.next(timeout=1), w.next(timeout=1)
+        assert (e1.type, e1.obj["n"]) == (ADDED, "a")
+        assert (e2.type, e2.obj["n"]) == (MODIFIED, "a2")
+        assert w.next(timeout=0.05) is None
+        w.stop()
+
+    def test_replay_from_rv(self):
+        s = MemStore()
+        rv1 = s.create("/pods/ns/a", {"n": "a"})
+        s.create("/pods/ns/b", {"n": "b"})
+        w = s.watch("/pods/", since_rv=rv1)
+        ev = w.next(timeout=1)
+        assert ev.obj["n"] == "b" and ev.rv > rv1
+        w.stop()
+
+    def test_watch_from_current_rv_sees_nothing_old(self):
+        s = MemStore()
+        s.create("/pods/ns/a", {})
+        w = s.watch("/pods/", since_rv=s.current_rv)
+        assert w.next(timeout=0.05) is None
+        w.stop()
+
+    def test_too_old_resource_version(self):
+        s = MemStore(window=4)
+        for i in range(10):
+            s.create(f"/pods/ns/p{i}", {"i": i})
+        with pytest.raises(TooOldResourceVersion):
+            s.watch("/pods/", since_rv=1)
+        # within the window is fine
+        w = s.watch("/pods/", since_rv=s.current_rv - 2)
+        assert w.next(timeout=1) is not None
+        w.stop()
+
+    def test_compaction_forces_relist(self):
+        s = MemStore()
+        rv = s.create("/pods/ns/a", {})
+        s.create("/pods/ns/b", {})
+        s.compact()
+        with pytest.raises(TooOldResourceVersion):
+            s.watch("/pods/", since_rv=rv)
+
+    def test_stop_unblocks_iteration(self):
+        s = MemStore()
+        w = s.watch("/")
+        got = []
+
+        def consume():
+            for ev in w:
+                got.append(ev)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        s.create("/k", {})
+        w.stop()
+        t.join(timeout=2)
+        assert not t.is_alive()
+        assert len(got) == 1
