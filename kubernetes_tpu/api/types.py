@@ -712,6 +712,24 @@ def get_pod_scheduler_name(pod: Pod) -> str:
     return ann.get(ANN_SCHEDULER_NAME, DEFAULT_SCHEDULER_NAME)
 
 
+# field-selector keys each kind supports (reference per-resource
+# <Resource>ToSelectableFields + 400 "field label not supported")
+SUPPORTED_FIELDS: Dict[str, frozenset] = {
+    "Pod": frozenset({"metadata.name", "metadata.namespace", "spec.nodeName",
+                      "status.phase"}),
+    "Node": frozenset({"metadata.name", "metadata.namespace", "spec.unschedulable"}),
+    "Event": frozenset({"metadata.name", "metadata.namespace",
+                        "involvedObject.kind", "involvedObject.namespace",
+                        "involvedObject.name", "involvedObject.uid",
+                        "reason", "source", "type"}),
+}
+_DEFAULT_FIELDS = frozenset({"metadata.name", "metadata.namespace"})
+
+
+def supported_fields(kind: str) -> frozenset:
+    return SUPPORTED_FIELDS.get(kind, _DEFAULT_FIELDS)
+
+
 def object_fields(obj) -> Dict[str, str]:
     """Flat field map for field selectors (reference pkg/registry/<r>/strategy.go
     <Resource>ToSelectableFields)."""

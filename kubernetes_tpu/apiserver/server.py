@@ -140,6 +140,25 @@ class _Handler(BaseHTTPRequestHandler):
     # --- dispatch ------------------------------------------------------------
 
     def _route(self, method: str):
+        if "watch=true" in self.path or "watch=1" in self.path:
+            # watch streams live for hours; timing them as requests would
+            # poison the latency histogram (they have their own counter)
+            try:
+                self._route_inner(method)
+            except RegistryError as e:
+                self._send_status(e.code, e.reason, e.message)
+            except TooOldResourceVersion as e:
+                self._send_status(410, "Expired", str(e))
+            except BrokenPipeError:
+                pass
+            except Exception as e:
+                import traceback
+                traceback.print_exc()
+                try:
+                    self._send_status(500, "InternalError", f"{type(e).__name__}: {e}")
+                except Exception:
+                    pass
+            return
         with METRICS.time("apiserver_request_seconds", verb=method):
             try:
                 self._route_inner(method)
@@ -230,13 +249,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     # --- collection handlers -------------------------------------------------
 
-    def _selectors(self, q):
-        lsel = labelsel.parse_selector(q.get("labelSelector"))
-        fsel = fieldsel.parse_field_selector(q.get("fieldSelector"))
+    def _selectors(self, q, kind: Optional[str] = None):
+        try:
+            lsel = labelsel.parse_selector(q.get("labelSelector"))
+            fsel = fieldsel.parse_field_selector(q.get("fieldSelector"))
+        except (labelsel.SelectorError, fieldsel.FieldSelectorError) as e:
+            raise bad_request(str(e)) from None
+        if kind is not None:
+            allowed = api.supported_fields(kind)
+            for r in fsel.requirements:
+                if r.key not in allowed:
+                    raise bad_request(f"field label not supported: {r.key}")
         return lsel, fsel
 
     def _serve_list(self, resource, ns, q):
-        lsel, fsel = self._selectors(q)
+        lsel, fsel = self._selectors(q, kind=RESOURCES[resource].kind)
         items, rv = self.registry.list(resource, ns, lsel, fsel)
         rd = RESOURCES[resource]
         self._send_json(200, {
@@ -255,7 +282,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_status(201, "Created", "binding created")
 
     def _serve_watch(self, resource, ns, q):
-        lsel, fsel = self._selectors(q)
+        lsel, fsel = self._selectors(q, kind=RESOURCES[resource].kind)
         since = q.get("resourceVersion")
         try:
             since_rv = int(since) if since not in (None, "") else None
@@ -276,10 +303,11 @@ class _Handler(BaseHTTPRequestHandler):
                     # peer raises BrokenPipe and we reclaim thread + watcher
                     self._write_chunk(b"\n")
                     continue
-                obj = self.registry._decode(rd, ev.obj, ev.rv)
-                if not Registry._matches(obj, lsel, fsel):
+                out = self._transform_for_selectors(rd, ev, lsel, fsel)
+                if out is None:
                     continue
-                frame = json.dumps({"type": ev.type,
+                etype, obj = out
+                frame = json.dumps({"type": etype,
                                     "object": scheme.encode(obj)},
                                    separators=(",", ":")).encode() + b"\n"
                 self._write_chunk(frame)
@@ -291,6 +319,30 @@ class _Handler(BaseHTTPRequestHandler):
                 self._write_chunk(b"")  # terminal chunk
             except OSError:
                 pass
+
+    def _transform_for_selectors(self, rd, ev, lsel, fsel):
+        """Selector-filtered watch must tell clients when an object *leaves*
+        the selected set (else their caches go permanently stale): an event
+        whose object no longer matches but whose previous state did becomes
+        DELETED; one entering the set becomes ADDED (reference etcd_watcher /
+        cacher transform). Returns (type, obj) or None to drop."""
+        obj = self.registry._decode(rd, ev.obj, ev.rv)
+        if (lsel is None or lsel.empty()) and (fsel is None or fsel.empty()):
+            return ev.type, obj
+        cur = Registry._matches(obj, lsel, fsel)
+        prev_match = False
+        if ev.prev_obj is not None:
+            prev = self.registry._decode(rd, ev.prev_obj, None)
+            prev_match = Registry._matches(prev, lsel, fsel)
+        if ev.type == "DELETED":
+            return ("DELETED", obj) if (cur or prev_match) else None
+        if cur and not prev_match:
+            return "ADDED", obj
+        if cur and prev_match:
+            return ev.type, obj
+        if not cur and prev_match:
+            return "DELETED", obj
+        return None
 
     def _write_chunk(self, data: bytes):
         if data:

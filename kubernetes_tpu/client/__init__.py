@@ -1,0 +1,14 @@
+"""L4 client + shared state-replication runtime.
+
+Parity target: reference pkg/client — restclient (QPS-limited typed HTTP
+client), cache (Reflector/Store/FIFO/DeltaFIFO/listers), the informer
+framework (pkg/controller/framework), and record (event broadcasting with
+dedup). This layer is the system's distributed communication backend: every
+component above it (scheduler, controllers, kubelet, proxy, CLI) talks to the
+cluster exclusively through it.
+"""
+
+from kubernetes_tpu.client.rest import ApiError, RESTClient
+from kubernetes_tpu.client.cache import FIFO, DeltaFIFO, ThreadSafeStore, meta_namespace_key
+from kubernetes_tpu.client.reflector import ListWatch, Reflector
+from kubernetes_tpu.client.informer import Informer

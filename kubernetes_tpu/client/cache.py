@@ -1,0 +1,214 @@
+"""Keyed stores and queues for the state-replication runtime.
+
+Parity target: reference pkg/client/cache — ThreadSafeStore
+(thread_safe_store.go), the blocking FIFO the scheduler pops pending pods
+from (fifo.go:54,191), and DeltaFIFO (delta_fifo.go) which preserves event
+sequences per key for informer consumers.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import types as api
+
+
+def meta_namespace_key(obj) -> str:
+    """namespace/name key (reference MetaNamespaceKeyFunc)."""
+    meta = obj.metadata
+    if meta.namespace:
+        return f"{meta.namespace}/{meta.name}"
+    return meta.name
+
+
+class ThreadSafeStore:
+    """Keyed object store with optional named indexes
+    (reference thread_safe_store.go + Indexer)."""
+
+    def __init__(self, indexers: Optional[Dict[str, Callable]] = None):
+        self._lock = threading.RLock()
+        self._items: Dict[str, object] = {}
+        self._indexers = indexers or {}
+        self._indices: Dict[str, Dict[str, set]] = {n: {} for n in self._indexers}
+
+    def add(self, key: str, obj):
+        with self._lock:
+            old = self._items.get(key)
+            self._items[key] = obj
+            self._update_indices(key, old, obj)
+
+    update = add
+
+    def delete(self, key: str):
+        with self._lock:
+            old = self._items.pop(key, None)
+            if old is not None:
+                self._update_indices(key, old, None)
+
+    def get(self, key: str):
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self) -> list:
+        with self._lock:
+            return list(self._items.values())
+
+    def list_keys(self) -> list:
+        with self._lock:
+            return list(self._items.keys())
+
+    def replace(self, items: Dict[str, object]):
+        with self._lock:
+            self._items = dict(items)
+            self._indices = {n: {} for n in self._indexers}
+            for key, obj in self._items.items():
+                self._update_indices(key, None, obj)
+
+    def by_index(self, index_name: str, value: str) -> list:
+        with self._lock:
+            keys = self._indices.get(index_name, {}).get(value, ())
+            return [self._items[k] for k in keys if k in self._items]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._items)
+
+    def _update_indices(self, key: str, old, new):
+        for name, fn in self._indexers.items():
+            idx = self._indices[name]
+            if old is not None:
+                for v in fn(old):
+                    s = idx.get(v)
+                    if s:
+                        s.discard(key)
+            if new is not None:
+                for v in fn(new):
+                    idx.setdefault(v, set()).add(key)
+
+
+def node_name_indexer(pod) -> List[str]:
+    """Index assigned pods by node (the scheduler's assigned-pod indexer)."""
+    if pod.spec and pod.spec.node_name:
+        return [pod.spec.node_name]
+    return []
+
+
+class FIFO:
+    """Blocking producer/consumer queue keyed by object; re-adds replace the
+    queued value in place (reference fifo.go — the scheduler's pending-pod
+    queue, factory.go:104)."""
+
+    def __init__(self, key_func: Callable = meta_namespace_key):
+        self._lock = threading.Condition()
+        self._items: "OrderedDict[str, object]" = OrderedDict()
+        self._key = key_func
+        self._closed = False
+
+    def add(self, obj):
+        key = self._key(obj)
+        with self._lock:
+            replaced = key in self._items
+            self._items[key] = obj
+            if not replaced:
+                self._lock.notify()
+
+    def add_if_not_present(self, obj):
+        key = self._key(obj)
+        with self._lock:
+            if key not in self._items:
+                self._items[key] = obj
+                self._lock.notify()
+
+    def delete(self, obj):
+        with self._lock:
+            self._items.pop(self._key(obj), None)
+
+    def pop(self, timeout: Optional[float] = None):
+        """Block until an item is available; None on timeout/close."""
+        with self._lock:
+            while not self._items:
+                if self._closed or not self._lock.wait(timeout=timeout):
+                    return None
+            _, obj = self._items.popitem(last=False)
+            return obj
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._items)
+
+
+class DeltaFIFO:
+    """Queue of per-key delta sequences [(type, obj), ...] — consumers see
+    every intermediate state (reference delta_fifo.go). Types: Added,
+    Updated, Deleted, Sync."""
+
+    ADDED = "Added"
+    UPDATED = "Updated"
+    DELETED = "Deleted"
+    SYNC = "Sync"
+
+    def __init__(self, key_func: Callable = meta_namespace_key):
+        self._lock = threading.Condition()
+        self._deltas: "OrderedDict[str, List[Tuple[str, object]]]" = OrderedDict()
+        self._key = key_func
+        self._known: Dict[str, object] = {}  # last state per key
+        self._closed = False
+
+    def _queue(self, dtype: str, obj, key: Optional[str] = None):
+        key = key or self._key(obj)
+        with self._lock:
+            fresh = key not in self._deltas
+            self._deltas.setdefault(key, []).append((dtype, obj))
+            if dtype == DeltaFIFO.DELETED:
+                self._known.pop(key, None)
+            else:
+                self._known[key] = obj
+            if fresh:
+                self._lock.notify()
+
+    def add(self, obj):
+        self._queue(DeltaFIFO.ADDED, obj)
+
+    def update(self, obj):
+        self._queue(DeltaFIFO.UPDATED, obj)
+
+    def delete(self, obj):
+        self._queue(DeltaFIFO.DELETED, obj)
+
+    def replace(self, objs: list):
+        """Full-state resync: emits Sync for live keys and Deleted for
+        known keys that vanished (the reflector re-list path)."""
+        new_keys = {self._key(o) for o in objs}
+        with self._lock:
+            vanished = [k for k in self._known if k not in new_keys]
+        for o in objs:
+            self._queue(DeltaFIFO.SYNC, o)
+        for k in vanished:
+            obj = self._known.get(k)
+            if obj is not None:
+                self._queue(DeltaFIFO.DELETED, obj, key=k)
+
+    def pop(self, timeout: Optional[float] = None):
+        """Block for the next (key, deltas) batch; None on timeout/close."""
+        with self._lock:
+            while not self._deltas:
+                if self._closed or not self._lock.wait(timeout=timeout):
+                    return None
+            key, deltas = self._deltas.popitem(last=False)
+            return key, deltas
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._deltas)

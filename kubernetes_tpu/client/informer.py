@@ -1,0 +1,116 @@
+"""Informer: Reflector + keyed cache + event-handler dispatch.
+
+Parity target: reference pkg/controller/framework/controller.go:213
+(NewInformer/NewIndexerInformer) — the pattern every controller and the
+scheduler's ConfigFactory build on: a local, always-warm cache of one
+resource plus add/update/delete callbacks, driven by a single Reflector.
+
+Handlers run on the informer's dispatch thread (one per informer, like the
+reference's processLoop goroutine): they must be fast and non-blocking, and
+hand real work to a workqueue.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.client.cache import ThreadSafeStore, meta_namespace_key
+from kubernetes_tpu.client.reflector import ListWatch, Reflector
+
+log = logging.getLogger("informer")
+
+
+class Informer:
+    def __init__(self, lw: ListWatch, key_func: Callable = meta_namespace_key,
+                 indexers: Optional[Dict[str, Callable]] = None):
+        self.store = ThreadSafeStore(indexers)
+        self.key = key_func
+        self._handlers: List[dict] = []
+        self._events: "queue.Queue" = queue.Queue()
+        self.reflector = Reflector(lw, self._Sink(self))
+        self._dispatch_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    class _Sink:
+        """Applies reflector events to the store synchronously (so the cache
+        is updated in event order) and queues handler dispatch."""
+
+        def __init__(self, informer: "Informer"):
+            self.inf = informer
+
+        def replace(self, items):
+            inf = self.inf
+            keyed = {inf.key(o): o for o in items}
+            old = {k: inf.store.get(k) for k in inf.store.list_keys()}
+            inf.store.replace(keyed)
+            for k, o in keyed.items():
+                prev = old.get(k)
+                if prev is None:
+                    inf._events.put(("add", None, o))
+                else:
+                    inf._events.put(("update", prev, o))
+            for k, prev in old.items():
+                if k not in keyed and prev is not None:
+                    inf._events.put(("delete", prev, None))
+
+        def add(self, obj):
+            self.inf.store.add(self.inf.key(obj), obj)
+            self.inf._events.put(("add", None, obj))
+
+        def update(self, obj):
+            prev = self.inf.store.get(self.inf.key(obj))
+            self.inf.store.update(self.inf.key(obj), obj)
+            self.inf._events.put(("update", prev, obj))
+
+        def delete(self, obj):
+            prev = self.inf.store.get(self.inf.key(obj)) or obj
+            self.inf.store.delete(self.inf.key(obj))
+            self.inf._events.put(("delete", prev, None))
+
+    def add_event_handler(self, on_add: Optional[Callable] = None,
+                          on_update: Optional[Callable] = None,
+                          on_delete: Optional[Callable] = None):
+        """on_add(obj), on_update(old, new), on_delete(obj)."""
+        self._handlers.append({"add": on_add, "update": on_update,
+                               "delete": on_delete})
+        return self
+
+    def run(self):
+        self.reflector.run()
+        self._dispatch_thread = threading.Thread(target=self._dispatch,
+                                                 name="informer-dispatch",
+                                                 daemon=True)
+        self._dispatch_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.reflector.stop()
+        self._events.put(None)
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self.reflector.wait_for_sync(timeout)
+
+    @property
+    def has_synced(self) -> bool:
+        return self.reflector.has_synced
+
+    def _dispatch(self):
+        while not self._stop.is_set():
+            item = self._events.get()
+            if item is None:
+                return
+            kind, old, new = item
+            for h in self._handlers:
+                try:
+                    if kind == "add" and h["add"]:
+                        h["add"](new)
+                    elif kind == "update" and h["update"]:
+                        h["update"](old, new)
+                    elif kind == "delete" and h["delete"]:
+                        h["delete"](old)
+                except Exception:  # HandleCrash: log, keep dispatching
+                    log.exception("informer handler failed")

@@ -1,0 +1,119 @@
+"""Typed read views over informer stores.
+
+Parity target: reference pkg/client/cache/listers.go — StoreToPodLister,
+StoreToNodeLister (with the readiness filtering the scheduler applies,
+factory.go:332,434-454), StoreToServiceLister/StoreToControllerLister/
+StoreToReplicaSetLister with GetPodX helpers used by the spreading priority.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.cache import ThreadSafeStore
+
+
+class PodLister:
+    def __init__(self, store: ThreadSafeStore):
+        self.store = store
+
+    def list(self, selector: Optional[labelsel.Selector] = None) -> List[api.Pod]:
+        pods = self.store.list()
+        if selector is None or selector.empty():
+            return pods
+        return [p for p in pods
+                if selector.matches((p.metadata.labels or {}) if p.metadata else {})]
+
+    def by_node(self, node_name: str) -> List[api.Pod]:
+        return self.store.by_index("node", node_name)
+
+
+class NodeLister:
+    def __init__(self, store: ThreadSafeStore,
+                 predicate: Optional[Callable[[api.Node], bool]] = None):
+        self.store = store
+        self.predicate = predicate or node_is_ready
+
+    def list(self) -> List[api.Node]:
+        """Ready nodes only — the scheduler never sees NotReady nodes
+        (reference getNodeConditionPredicate, factory.go:434-454)."""
+        return [n for n in self.store.list() if self.predicate(n)]
+
+    def list_all(self) -> List[api.Node]:
+        return self.store.list()
+
+
+def node_is_ready(node: api.Node) -> bool:
+    """Schedulable = Ready=True and OutOfDisk!=True and not unschedulable
+    (reference factory.go:434-454)."""
+    if node.spec and node.spec.unschedulable:
+        return False
+    conds = (node.status.conditions or []) if node.status else []
+    ready = False
+    for c in conds:
+        if c.type == api.NODE_READY:
+            ready = c.status == api.CONDITION_TRUE
+        elif c.type == api.NODE_OUT_OF_DISK and c.status == api.CONDITION_TRUE:
+            return False
+    return ready
+
+
+class ServiceLister:
+    def __init__(self, store: ThreadSafeStore):
+        self.store = store
+
+    def list(self) -> List[api.Service]:
+        return self.store.list()
+
+    def get_pod_services(self, pod: api.Pod) -> List[api.Service]:
+        """Services whose selector matches the pod (same namespace) —
+        reference listers.go GetPodServices."""
+        out = []
+        pod_labels = (pod.metadata.labels or {}) if pod.metadata else {}
+        for svc in self.store.list():
+            if svc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = svc.spec.selector if svc.spec else None
+            if sel and labelsel.selector_from_map(sel).matches(pod_labels):
+                out.append(svc)
+        return out
+
+
+class ControllerLister:
+    def __init__(self, store: ThreadSafeStore):
+        self.store = store
+
+    def list(self) -> List[api.ReplicationController]:
+        return self.store.list()
+
+    def get_pod_controllers(self, pod: api.Pod) -> List[api.ReplicationController]:
+        out = []
+        pod_labels = (pod.metadata.labels or {}) if pod.metadata else {}
+        for rc in self.store.list():
+            if rc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = rc.spec.selector if rc.spec else None
+            if sel and labelsel.selector_from_map(sel).matches(pod_labels):
+                out.append(rc)
+        return out
+
+
+class ReplicaSetLister:
+    def __init__(self, store: ThreadSafeStore):
+        self.store = store
+
+    def list(self) -> List[api.ReplicaSet]:
+        return self.store.list()
+
+    def get_pod_replica_sets(self, pod: api.Pod) -> List[api.ReplicaSet]:
+        out = []
+        pod_labels = (pod.metadata.labels or {}) if pod.metadata else {}
+        for rs in self.store.list():
+            if rs.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = rs.spec.selector if rs.spec else None
+            if sel is not None and labelsel.selector_from_label_selector(sel).matches(pod_labels):
+                out.append(rs)
+        return out

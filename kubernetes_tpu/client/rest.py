@@ -1,0 +1,234 @@
+"""Typed REST client with client-side flow control.
+
+Parity target: reference pkg/client/restclient — QPS/burst token bucket on
+every request (config.go:96-103), typed encode/decode through the scheme,
+structured Status errors, and a streaming watch that yields (event_type,
+object) tuples from the NDJSON frames (pkg/client/restclient/request.go Watch).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from typing import Iterator, Optional, Tuple
+from urllib.parse import quote
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import from_dict, scheme, to_dict
+from kubernetes_tpu.registry.generic import RESOURCES
+from kubernetes_tpu.utils.flowcontrol import TokenBucket
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, reason: str, message: str):
+        self.code = code
+        self.reason = reason
+        self.message = message
+        super().__init__(f"{code} {reason}: {message}")
+
+    @property
+    def is_not_found(self):
+        return self.code == 404
+
+    @property
+    def is_conflict(self):
+        return self.code == 409
+
+    @property
+    def is_gone(self):
+        return self.code == 410
+
+
+class WatchStream:
+    """Iterator over watch frames; `stop()` closes the connection."""
+
+    def __init__(self, conn: http.client.HTTPConnection, resp, cls):
+        self._conn = conn
+        self._resp = resp
+        self._cls = cls
+        self._stopped = False
+
+    def __iter__(self) -> Iterator[Tuple[str, object]]:
+        try:
+            while not self._stopped:
+                line = self._resp.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if not line:
+                    continue  # heartbeat
+                frame = json.loads(line)
+                obj = from_dict(self._cls, frame["object"])
+                yield frame["type"], obj
+        except (http.client.HTTPException, OSError, ValueError, AttributeError):
+            # AttributeError: http.client raises it when the response's
+            # buffered reader is torn down mid-readline by stop()
+            if not self._stopped:
+                raise
+        finally:
+            self.stop()
+
+    def stop(self):
+        self._stopped = True
+        # shut down the socket first: close() would block on the reader
+        # buffer's lock while another thread is parked in readline(); a
+        # SHUT_RDWR makes that readline return immediately instead
+        import socket as _socket
+        sock = getattr(self._conn, "sock", None)
+        if sock is not None:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class RESTClient:
+    """One logical client per component, identified by user_agent; qps/burst
+    mirror the reference's --kube-api-qps/--kube-api-burst flags."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8080,
+                 qps: float = 50.0, burst: int = 100,
+                 user_agent: str = "kubernetes-tpu-client", timeout: float = 30.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.user_agent = user_agent
+        self._limiter = TokenBucket(qps, burst)
+        self._local = threading.local()
+
+    @classmethod
+    def for_server(cls, server, **kw) -> "RESTClient":
+        return cls(host="127.0.0.1", port=server.port, **kw)
+
+    # --- low-level -----------------------------------------------------------
+
+    def _conn(self) -> http.client.HTTPConnection:
+        # one keep-alive connection per thread
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout)
+            self._local.conn = conn
+        return conn
+
+    def _drop_conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._local.conn = None
+
+    def request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        self._limiter.accept()
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"User-Agent": self.user_agent}
+        if payload is not None:
+            headers["Content-Type"] = "application/json"
+        for attempt in (1, 2):  # one retry on a stale keep-alive connection
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                break
+            except (http.client.HTTPException, OSError):
+                self._drop_conn()
+                if attempt == 2:
+                    raise
+        parsed = json.loads(data) if data else {}
+        if resp.status >= 400:
+            raise ApiError(resp.status, parsed.get("reason", "Unknown"),
+                           parsed.get("message", ""))
+        return parsed
+
+    # --- paths ---------------------------------------------------------------
+
+    @staticmethod
+    def _collection_path(resource: str, namespace: str = "") -> str:
+        rd = RESOURCES.get(resource)
+        if rd is not None and not rd.namespaced:
+            return f"/api/v1/{resource}"
+        if namespace:
+            return f"/api/v1/namespaces/{namespace}/{resource}"
+        return f"/api/v1/{resource}"
+
+    def _item_path(self, resource: str, name: str, namespace: str = "") -> str:
+        return f"{self._collection_path(resource, namespace)}/{quote(name)}"
+
+    @staticmethod
+    def _query(label_selector=None, field_selector=None, **extra) -> str:
+        parts = []
+        if label_selector:
+            parts.append("labelSelector=" + quote(str(label_selector)))
+        if field_selector:
+            parts.append("fieldSelector=" + quote(str(field_selector)))
+        parts += [f"{k}={quote(str(v))}" for k, v in extra.items() if v is not None]
+        return ("?" + "&".join(parts)) if parts else ""
+
+    # --- typed verbs ---------------------------------------------------------
+
+    def create(self, resource: str, obj, namespace: str = ""):
+        ns = namespace or (obj.metadata.namespace if obj.metadata else "")
+        d = self.request("POST", self._collection_path(resource, ns), scheme.encode(obj))
+        return from_dict(RESOURCES[resource].cls, d)
+
+    def get(self, resource: str, name: str, namespace: str = ""):
+        d = self.request("GET", self._item_path(resource, name, namespace))
+        return from_dict(RESOURCES[resource].cls, d)
+
+    def list(self, resource: str, namespace: str = "",
+             label_selector=None, field_selector=None):
+        """Returns (items, list_resource_version)."""
+        path = self._collection_path(resource, namespace) + self._query(
+            label_selector, field_selector)
+        d = self.request("GET", path)
+        cls = RESOURCES[resource].cls
+        items = [from_dict(cls, i) for i in d.get("items", [])]
+        return items, int(d.get("metadata", {}).get("resourceVersion", "0"))
+
+    def update(self, resource: str, obj, namespace: str = ""):
+        ns = namespace or (obj.metadata.namespace if obj.metadata else "")
+        d = self.request("PUT", self._item_path(resource, obj.metadata.name, ns),
+                         scheme.encode(obj))
+        return from_dict(RESOURCES[resource].cls, d)
+
+    def update_status(self, resource: str, obj, namespace: str = ""):
+        ns = namespace or (obj.metadata.namespace if obj.metadata else "")
+        d = self.request("PUT",
+                         self._item_path(resource, obj.metadata.name, ns) + "/status",
+                         scheme.encode(obj))
+        return from_dict(RESOURCES[resource].cls, d)
+
+    def delete(self, resource: str, name: str, namespace: str = ""):
+        d = self.request("DELETE", self._item_path(resource, name, namespace))
+        return from_dict(RESOURCES[resource].cls, d)
+
+    def bind(self, binding: api.Binding, namespace: str):
+        """The scheduler's single write (reference factory.go:563-570)."""
+        self.request("POST", f"/api/v1/namespaces/{namespace}/bindings",
+                     scheme.encode(binding))
+
+    def watch(self, resource: str, namespace: str = "", resource_version=None,
+              label_selector=None, field_selector=None) -> WatchStream:
+        """Open a streaming watch. Not rate-limited (watches are long-lived;
+        the reference also exempts them)."""
+        path = self._collection_path(resource, namespace) + self._query(
+            label_selector, field_selector, watch="true",
+            resourceVersion=resource_version)
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout + 35)
+        conn.request("GET", path, headers={"User-Agent": self.user_agent})
+        resp = conn.getresponse()
+        if resp.status >= 400:
+            data = resp.read()
+            parsed = json.loads(data) if data else {}
+            conn.close()
+            raise ApiError(resp.status, parsed.get("reason", "Unknown"),
+                           parsed.get("message", ""))
+        return WatchStream(conn, resp, RESOURCES[resource].cls)

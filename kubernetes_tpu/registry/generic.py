@@ -106,9 +106,13 @@ def _pod_prepare_create(pod: api.Pod):
 
 
 def _pod_prepare_update(new: api.Pod, old: api.Pod):
-    # spec.nodeName is immutable once set, except "" -> value via binding
-    if old.spec and old.spec.node_name and new.spec and new.spec.node_name != old.spec.node_name:
-        raise invalid("spec.nodeName: field is immutable")
+    # spec.nodeName may never change via PUT — assignment happens only
+    # through the binding subresource's CAS (which bypasses this hook), so a
+    # read-modify-write client can't race the scheduler into an assignment
+    old_nn = old.spec.node_name if old.spec else ""
+    new_nn = new.spec.node_name if new.spec else ""
+    if old_nn != new_nn:
+        raise invalid("spec.nodeName: may only be set via the bindings subresource")
 
 
 def _event_prepare_create(ev: api.Event):
@@ -242,20 +246,30 @@ class Registry:
         return obj
 
     def guaranteed_update(self, resource: str, name: str, namespace: str,
-                          fn: Callable):
-        """Typed CAS loop: fn(typed_obj) -> typed_obj or None (no-op)."""
+                          fn: Callable, max_retries: int = 10):
+        """Typed CAS loop: fn(typed_obj) -> typed_obj or None (no-op). The
+        typed object fn sees carries its current resourceVersion so fn can
+        enforce client preconditions."""
         rd = self._def(resource)
-
-        def raw_fn(d: dict):
-            obj = self._decode(rd, d, None)
+        key = rd.key(namespace, name)
+        for _ in range(max_retries):
+            try:
+                d, rv = self.store.get(key)
+            except KeyNotFound:
+                raise not_found(rd.kind, name) from None
+            obj = self._decode(rd, d, rv)
             new = fn(obj)
-            return None if new is None else to_dict(new)
-
-        try:
-            d, rv = self.store.guaranteed_update(rd.key(namespace, name), raw_fn)
-        except KeyNotFound:
-            raise not_found(rd.kind, name) from None
-        return self._decode(rd, d, rv)
+            if new is None:
+                return obj
+            try:
+                new_rv = self.store.update(key, to_dict(new), expect_rv=rv)
+            except Conflict:
+                continue
+            except KeyNotFound:
+                raise not_found(rd.kind, name) from None
+            new.metadata.resource_version = str(new_rv)
+            return new
+        raise conflict(rd.kind, name, "too much contention")
 
     def delete(self, resource: str, name: str, namespace: str = ""):
         rd = self._def(resource)
@@ -305,7 +319,14 @@ class Registry:
         rd = self._def(resource)
         meta = obj.metadata or api.ObjectMeta()
 
+        expect_rv = meta.resource_version
+
         def set_status(cur):
+            # honor the optimistic-concurrency precondition like plain PUT:
+            # a stale status writer must get 409, not silently win
+            if expect_rv and cur.metadata.resource_version != expect_rv:
+                raise conflict(rd.kind, meta.name,
+                               f"rv {expect_rv} != current {cur.metadata.resource_version}")
             cur.status = obj.status
             if rd.validator:
                 try:

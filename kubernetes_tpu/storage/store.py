@@ -65,6 +65,9 @@ class Event:
     key: str
     rv: int
     obj: dict  # for DELETED, the last state of the object
+    prev_obj: Optional[dict] = None  # state before this event (etcd prevKV);
+    # lets selector-filtered watches synthesize ADDED/DELETED on set
+    # transitions (the reference cacher/etcd_watcher transform)
 
 
 def _copy(obj: dict) -> dict:
@@ -161,7 +164,9 @@ class MemStore:
             self._rv += 1
             obj = _copy(obj)
             self._data[key] = (obj, self._rv)
-            self._publish(Event(ADDED, key, self._rv, obj))
+            # events carry their own copy so a watcher mutating ev.obj cannot
+            # corrupt authoritative state
+            self._publish(Event(ADDED, key, self._rv, _copy(obj)))
             return self._rv
 
     def update(self, key: str, obj: dict, expect_rv: Optional[int] = None) -> int:
@@ -169,13 +174,13 @@ class MemStore:
         with self._lock:
             if key not in self._data:
                 raise KeyNotFound(key)
-            _, cur_rv = self._data[key]
+            prev, cur_rv = self._data[key]
             if expect_rv is not None and expect_rv != cur_rv:
                 raise Conflict(f"{key}: rv {expect_rv} != current {cur_rv}")
             self._rv += 1
             obj = _copy(obj)
             self._data[key] = (obj, self._rv)
-            self._publish(Event(MODIFIED, key, self._rv, obj))
+            self._publish(Event(MODIFIED, key, self._rv, _copy(obj), prev_obj=prev))
             return self._rv
 
     def guaranteed_update(self, key: str,
@@ -206,7 +211,7 @@ class MemStore:
                 raise Conflict(f"{key}: rv {expect_rv} != current {cur_rv}")
             self._rv += 1
             del self._data[key]
-            self._publish(Event(DELETED, key, self._rv, obj))
+            self._publish(Event(DELETED, key, self._rv, _copy(obj), prev_obj=obj))
             return _copy(obj), self._rv
 
     # --- watch ---------------------------------------------------------------

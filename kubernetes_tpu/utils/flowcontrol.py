@@ -1,0 +1,88 @@
+"""Client-side flow control.
+
+Parity target: reference pkg/util/flowcontrol — the QPS+burst token bucket
+every RESTClient passes requests through (restclient/config.go:96-103,
+throttle.go) and the per-item exponential Backoff used by the scheduler's
+pod requeue path (factory.go:503-539) and node controller.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class TokenBucket:
+    """QPS rate limiter with burst. `accept()` blocks until a token is
+    available (reference RateLimiter.Accept)."""
+
+    def __init__(self, qps: float, burst: int, clock=time.monotonic):
+        assert qps > 0 and burst >= 1
+        self.qps = qps
+        self.burst = burst
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def _refill(self):
+        now = self._clock()
+        self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+        self._last = now
+
+    def try_accept(self) -> bool:
+        with self._lock:
+            self._refill()
+            if self._tokens >= 1:
+                self._tokens -= 1
+                return True
+            return False
+
+    def accept(self):
+        while True:
+            with self._lock:
+                self._refill()
+                if self._tokens >= 1:
+                    self._tokens -= 1
+                    return
+                need = (1 - self._tokens) / self.qps
+            time.sleep(min(need, 0.1))
+
+
+class Backoff:
+    """Per-key exponential backoff with a cap and idle reset
+    (reference flowcontrol.Backoff; scheduler podBackoff uses
+    initial=1s max=60s, factory.go:100)."""
+
+    def __init__(self, initial: float = 1.0, maximum: float = 60.0,
+                 clock=time.monotonic):
+        self.initial = initial
+        self.maximum = maximum
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: Dict[str, tuple] = {}  # key -> (duration, last_update)
+
+    def next(self, key: str) -> float:
+        """Bump and return the backoff duration for key."""
+        with self._lock:
+            now = self._clock()
+            dur, last = self._entries.get(key, (0.0, now))
+            # idle longer than 2*max resets the entry (gc_expired analogue)
+            if now - last > 2 * self.maximum:
+                dur = 0.0
+            dur = self.initial if dur == 0 else min(dur * 2, self.maximum)
+            self._entries[key] = (dur, now)
+            return dur
+
+    def reset(self, key: str):
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def gc(self):
+        with self._lock:
+            now = self._clock()
+            stale = [k for k, (_, last) in self._entries.items()
+                     if now - last > 2 * self.maximum]
+            for k in stale:
+                del self._entries[k]

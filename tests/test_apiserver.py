@@ -213,6 +213,62 @@ class TestWatchHTTP:
         assert resp.status == 410
         conn.close()
 
+    def test_filtered_watch_synthesizes_deleted_on_set_exit(self, server):
+        """A pod leaving the selected set (unassigned -> bound) must appear
+        as DELETED on a spec.nodeName= watch, else informer caches go stale
+        (reference cacher/etcd_watcher transform)."""
+        req(server, "POST", "/api/v1/namespaces/default/pods", mk_pod_body("p"))
+        conn, resp = self._open_watch(
+            server, "/api/v1/pods?watch=true&resourceVersion=0&fieldSelector=spec.nodeName%3D")
+        ev = json.loads(resp.readline())
+        assert ev["type"] == "ADDED"
+        binding = {"kind": "Binding", "apiVersion": "v1",
+                   "metadata": {"name": "p", "namespace": "default"},
+                   "target": {"kind": "Node", "name": "n1"}}
+        req(server, "POST", "/api/v1/namespaces/default/bindings", binding)
+        ev2 = json.loads(resp.readline())
+        assert ev2["type"] == "DELETED"
+        assert ev2["object"]["metadata"]["name"] == "p"
+        conn.close()
+
+    def test_filtered_watch_synthesizes_added_on_set_entry(self, server):
+        req(server, "POST", "/api/v1/namespaces/default/pods",
+            mk_pod_body("p", labels={"app": "old"}))
+        conn, resp = self._open_watch(
+            server, "/api/v1/pods?watch=true&labelSelector=app%3Dnew")
+        _, got = req(server, "GET", "/api/v1/namespaces/default/pods/p")
+        got["metadata"]["labels"] = {"app": "new"}
+        req(server, "PUT", "/api/v1/namespaces/default/pods/p", got)
+        ev = json.loads(resp.readline())
+        assert ev["type"] == "ADDED"  # entered the selected set
+        conn.close()
+
+    def test_unsupported_field_key_400(self, server):
+        code, status = req(server, "GET",
+                           "/api/v1/pods?fieldSelector=spec.nodename%3Dn1")
+        assert code == 400 and "not supported" in status["message"]
+
+    def test_put_cannot_assign_node_name(self, server):
+        _, created = req(server, "POST", "/api/v1/namespaces/default/pods",
+                         mk_pod_body("p"))
+        created["spec"]["nodeName"] = "sneaky"
+        code, status = req(server, "PUT", "/api/v1/namespaces/default/pods/p",
+                           created)
+        assert code == 422 and "bindings subresource" in status["message"]
+
+    def test_stale_status_write_409(self, server):
+        _, created = req(server, "POST", "/api/v1/namespaces/default/pods",
+                         mk_pod_body("p"))
+        fresh = dict(created)
+        fresh["status"] = {"phase": "Running"}
+        assert req(server, "PUT", "/api/v1/namespaces/default/pods/p/status",
+                   fresh)[0] == 200
+        stale = dict(created)  # still carries the old resourceVersion
+        stale["status"] = {"phase": "Pending"}
+        code, _ = req(server, "PUT", "/api/v1/namespaces/default/pods/p/status",
+                      stale)
+        assert code == 409
+
     def test_watch_field_selector_filters(self, server):
         conn, resp = self._open_watch(
             server, "/api/v1/pods?watch=true&fieldSelector=spec.nodeName%3Dn1")

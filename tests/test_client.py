@@ -1,0 +1,319 @@
+"""Client runtime: RESTClient, caches, Reflector, Informer, listers, events
+against a live in-process API server (reference pkg/client/cache tests +
+framework controller tests)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.fields import parse_field_selector
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import (
+    FIFO, ApiError, DeltaFIFO, Informer, ListWatch, Reflector, RESTClient,
+    ThreadSafeStore, meta_namespace_key,
+)
+from kubernetes_tpu.client.cache import node_name_indexer
+from kubernetes_tpu.client.listers import (
+    NodeLister, PodLister, ServiceLister, node_is_ready,
+)
+from kubernetes_tpu.client.record import EventRecorder
+from kubernetes_tpu.client.reflector import StoreSink
+from kubernetes_tpu.utils.flowcontrol import Backoff, TokenBucket
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=500, burst=500)
+
+
+def mk_pod(name, ns="default", labels=None, node=""):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(node_name=node, containers=[api.Container(
+            name="c", image="pause",
+            resources=api.ResourceRequirements(requests={"cpu": "100m"}))]))
+
+
+def mk_node(name, ready=True):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name),
+        status=api.NodeStatus(
+            capacity={"cpu": "4", "memory": "8Gi", "pods": "110"},
+            conditions=[api.NodeCondition(type="Ready",
+                                          status="True" if ready else "False")]))
+
+
+class TestRESTClient:
+    def test_typed_crud(self, client):
+        created = client.create("pods", mk_pod("a", labels={"app": "x"}))
+        assert isinstance(created, api.Pod) and created.metadata.uid
+        got = client.get("pods", "a", "default")
+        assert got.metadata.name == "a"
+        items, rv = client.list("pods", "default")
+        assert len(items) == 1 and rv > 0
+        got.metadata.labels = {"app": "y"}
+        updated = client.update("pods", got)
+        assert updated.metadata.labels == {"app": "y"}
+        client.delete("pods", "a", "default")
+        with pytest.raises(ApiError) as ei:
+            client.get("pods", "a", "default")
+        assert ei.value.is_not_found
+
+    def test_selectors(self, client):
+        client.create("pods", mk_pod("w", labels={"app": "web"}))
+        client.create("pods", mk_pod("d", labels={"app": "db"}))
+        items, _ = client.list("pods", "default",
+                               label_selector=labelsel.parse_selector("app=web"))
+        assert [p.metadata.name for p in items] == ["w"]
+        items, _ = client.list("pods", field_selector=parse_field_selector("spec.nodeName="))
+        assert len(items) == 2
+
+    def test_bind(self, client):
+        client.create("pods", mk_pod("p"))
+        client.bind(api.Binding(metadata=api.ObjectMeta(name="p", namespace="default"),
+                                target=api.ObjectReference(kind="Node", name="n1")),
+                    "default")
+        assert client.get("pods", "p", "default").spec.node_name == "n1"
+
+    def test_watch_stream(self, client):
+        _, rv = client.list("pods")
+        stream = client.watch("pods", resource_version=rv)
+        got = []
+        t = threading.Thread(target=lambda: [got.append(x) for x in stream])
+        t.start()
+        client.create("pods", mk_pod("w1"))
+        time.sleep(0.3)
+        stream.stop()
+        t.join(timeout=2)
+        assert got and got[0][0] == "ADDED" and got[0][1].metadata.name == "w1"
+
+
+class TestFlowControl:
+    def test_token_bucket_blocks(self):
+        # fake clock so the test is deterministic
+        now = [0.0]
+        tb = TokenBucket(qps=10, burst=2, clock=lambda: now[0])
+        assert tb.try_accept() and tb.try_accept()
+        assert not tb.try_accept()
+        now[0] += 0.1  # one token refilled
+        assert tb.try_accept()
+        assert not tb.try_accept()
+
+    def test_backoff_doubles_to_cap(self):
+        now = [0.0]
+        b = Backoff(initial=1.0, maximum=8.0, clock=lambda: now[0])
+        assert [b.next("k") for _ in range(5)] == [1.0, 2.0, 4.0, 8.0, 8.0]
+        b.reset("k")
+        assert b.next("k") == 1.0
+        # idle reset
+        b.next("k")
+        now[0] += 100.0
+        assert b.next("k") == 1.0
+
+
+class TestCaches:
+    def test_fifo_blocking_pop(self):
+        f = FIFO()
+        out = []
+        t = threading.Thread(target=lambda: out.append(f.pop(timeout=5)))
+        t.start()
+        time.sleep(0.05)
+        f.add(mk_pod("a"))
+        t.join(timeout=2)
+        assert out[0].metadata.name == "a"
+
+    def test_fifo_readd_replaces(self):
+        f = FIFO()
+        f.add(mk_pod("a", labels={"v": "1"}))
+        f.add(mk_pod("a", labels={"v": "2"}))
+        f.add(mk_pod("b"))
+        assert len(f) == 2
+        assert f.pop().metadata.labels == {"v": "2"}
+
+    def test_fifo_add_if_not_present(self):
+        f = FIFO()
+        f.add(mk_pod("a", labels={"v": "1"}))
+        f.add_if_not_present(mk_pod("a", labels={"v": "2"}))
+        assert f.pop().metadata.labels == {"v": "1"}
+
+    def test_delta_fifo_sequences(self):
+        d = DeltaFIFO()
+        p = mk_pod("a")
+        d.add(p)
+        d.update(p)
+        d.delete(p)
+        key, deltas = d.pop()
+        assert key == "default/a"
+        assert [t for t, _ in deltas] == ["Added", "Updated", "Deleted"]
+
+    def test_delta_fifo_replace_emits_deletes(self):
+        d = DeltaFIFO()
+        d.add(mk_pod("a"))
+        d.pop()
+        d.replace([mk_pod("b")])
+        seen = {}
+        while len(d):
+            key, deltas = d.pop()
+            seen[key] = [t for t, _ in deltas]
+        assert seen["default/b"] == ["Sync"]
+        assert seen["default/a"] == ["Deleted"]
+
+    def test_indexer(self):
+        s = ThreadSafeStore(indexers={"node": node_name_indexer})
+        s.add("default/a", mk_pod("a", node="n1"))
+        s.add("default/b", mk_pod("b", node="n1"))
+        s.add("default/c", mk_pod("c", node="n2"))
+        assert {p.metadata.name for p in s.by_index("node", "n1")} == {"a", "b"}
+        s.delete("default/a")
+        assert {p.metadata.name for p in s.by_index("node", "n1")} == {"b"}
+
+
+class TestReflector:
+    def test_list_then_watch(self, server, client):
+        client.create("pods", mk_pod("pre"))
+        store = ThreadSafeStore()
+        refl = Reflector(ListWatch(client, "pods"),
+                         StoreSink(store, meta_namespace_key)).run()
+        assert refl.wait_for_sync(5)
+        assert store.get("default/pre") is not None
+        client.create("pods", mk_pod("live"))
+        _wait(lambda: store.get("default/live") is not None)
+        client.delete("pods", "live", "default")
+        _wait(lambda: store.get("default/live") is None)
+        refl.stop()
+
+    def test_relist_after_compaction(self, server, client):
+        store = ThreadSafeStore()
+        refl = Reflector(ListWatch(client, "pods"),
+                         StoreSink(store, meta_namespace_key)).run()
+        assert refl.wait_for_sync(5)
+        # advance rv past the window start, compact, then ask for the old rv:
+        # the server must answer 410 Gone (what drives a reflector re-list)
+        for i in range(3):
+            client.create("pods", mk_pod(f"x{i}"))
+        _wait(lambda: store.get("default/x2") is not None)
+        server.registry.store.compact()
+        with pytest.raises(ApiError) as ei:
+            client.watch("pods", resource_version=1)
+        assert ei.value.is_gone
+        refl.stop()
+
+    def test_unassigned_pod_selector_feed(self, server, client):
+        """The scheduler's FIFO feed: spec.nodeName== selector."""
+        fifo = FIFO()
+
+        class FIFOSink:
+            def replace(self, items):
+                for o in items:
+                    fifo.add(o)
+
+            def add(self, obj):
+                fifo.add(obj)
+
+            update = add
+
+            def delete(self, obj):
+                fifo.delete(obj)
+
+        refl = Reflector(ListWatch(client, "pods",
+                                   field_selector=parse_field_selector("spec.nodeName=")),
+                         FIFOSink()).run()
+        assert refl.wait_for_sync(5)
+        client.create("pods", mk_pod("pending"))
+        client.create("pods", mk_pod("assigned", node="n1"))
+        popped = fifo.pop(timeout=5)
+        assert popped.metadata.name == "pending"
+        assert len(f := fifo) == 0 or fifo.pop(timeout=0.2) is None
+        refl.stop()
+
+
+class TestInformer:
+    def test_handlers_and_store(self, server, client):
+        client.create("nodes", mk_node("n1"))
+        events = []
+        inf = Informer(ListWatch(client, "nodes"))
+        inf.add_event_handler(
+            on_add=lambda o: events.append(("add", o.metadata.name)),
+            on_update=lambda old, new: events.append(("update", new.metadata.name)),
+            on_delete=lambda o: events.append(("delete", o.metadata.name)))
+        inf.run()
+        assert inf.wait_for_sync(5)
+        client.create("nodes", mk_node("n2"))
+        _wait(lambda: inf.store.get("n2") is not None)
+        n2 = client.get("nodes", "n2")
+        n2.metadata.labels = {"x": "y"}
+        client.update("nodes", n2)
+        client.delete("nodes", "n2")
+        _wait(lambda: inf.store.get("n2") is None)
+        _wait(lambda: ("delete", "n2") in events)
+        assert ("add", "n1") in events and ("add", "n2") in events
+        assert ("update", "n2") in events
+        inf.stop()
+
+
+class TestListers:
+    def test_node_readiness_filter(self):
+        store = ThreadSafeStore()
+        store.add("ready", mk_node("ready"))
+        store.add("notready", mk_node("notready", ready=False))
+        cordoned = mk_node("cordoned")
+        cordoned.spec = api.NodeSpec(unschedulable=True)
+        store.add("cordoned", cordoned)
+        ool = mk_node("outofdisk")
+        ool.status.conditions.append(api.NodeCondition(type="OutOfDisk", status="True"))
+        store.add("outofdisk", ool)
+        lister = NodeLister(store)
+        assert [n.metadata.name for n in lister.list()] == ["ready"]
+        assert len(lister.list_all()) == 4
+
+    def test_get_pod_services(self):
+        store = ThreadSafeStore()
+        svc = api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                          spec=api.ServiceSpec(selector={"app": "web"},
+                                               ports=[api.ServicePort(port=80)]))
+        store.add("default/s", svc)
+        lister = ServiceLister(store)
+        assert lister.get_pod_services(mk_pod("p", labels={"app": "web"}))
+        assert not lister.get_pod_services(mk_pod("p", labels={"app": "db"}))
+        assert not lister.get_pod_services(mk_pod("p", ns="other", labels={"app": "web"}))
+
+
+class TestEventRecorder:
+    def test_dedup_aggregation(self, server, client):
+        rec = EventRecorder(client, "scheduler")
+        pod = client.create("pods", mk_pod("p"))
+        for _ in range(3):
+            rec.event(pod, "Warning", "FailedScheduling", "no nodes available")
+        rec.flush()
+        _wait(lambda: client.list("events", "default")[0])
+        events, _ = client.list("events", "default")
+        assert len(events) == 1
+        _wait(lambda: client.list("events", "default")[0][0].count == 3)
+        ev = client.list("events", "default")[0][0]
+        assert ev.reason == "FailedScheduling"
+        assert ev.involved_object.name == "p"
+        rec.event(pod, "Normal", "Scheduled", "bound to n1")
+        rec.flush()
+        _wait(lambda: len(client.list("events", "default")[0]) == 2)
+
+
+def _wait(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:
+            pass
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
