@@ -26,6 +26,7 @@ idiomatic Python analogue of the reference's goroutine-per-request model.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import re
 import socket
@@ -140,26 +141,13 @@ class _Handler(BaseHTTPRequestHandler):
     # --- dispatch ------------------------------------------------------------
 
     def _route(self, method: str):
-        if "watch=true" in self.path or "watch=1" in self.path:
-            # watch streams live for hours; timing them as requests would
-            # poison the latency histogram (they have their own counter)
-            try:
-                self._route_inner(method)
-            except RegistryError as e:
-                self._send_status(e.code, e.reason, e.message)
-            except TooOldResourceVersion as e:
-                self._send_status(410, "Expired", str(e))
-            except BrokenPipeError:
-                pass
-            except Exception as e:
-                import traceback
-                traceback.print_exc()
-                try:
-                    self._send_status(500, "InternalError", f"{type(e).__name__}: {e}")
-                except Exception:
-                    pass
-            return
-        with METRICS.time("apiserver_request_seconds", verb=method):
+        # watch streams live for hours; timing them as requests would poison
+        # the latency histogram (they have their own counter)
+        q = parse_qs(urlparse(self.path).query)
+        is_watch = q.get("watch", ["false"])[0] in ("true", "1")
+        timer = (contextlib.nullcontext() if is_watch
+                 else METRICS.time("apiserver_request_seconds", verb=method))
+        with timer:
             try:
                 self._route_inner(method)
             except RegistryError as e:

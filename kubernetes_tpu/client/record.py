@@ -13,16 +13,17 @@ import logging
 import queue
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Optional, Tuple
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.client.rest import ApiError, RESTClient
+from kubernetes_tpu.utils.timeutil import now_iso as _now_iso
 
 log = logging.getLogger("events")
 
-
-def _now_iso() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+# aggregation cache cap (the reference's events_cache LRU analogue)
+MAX_AGGREGATION_ENTRIES = 4096
 
 
 class EventRecorder:
@@ -34,7 +35,9 @@ class EventRecorder:
                  source_host: str = ""):
         self.client = client
         self.source = api.EventSource(component=source_component, host=source_host)
-        self._seen: Dict[Tuple, Tuple[str, int]] = {}  # agg key -> (event name, count)
+        # agg key -> (event name, count); LRU-capped so long-running
+        # components don't grow without bound
+        self._seen: "OrderedDict[Tuple, Tuple[str, int]]" = OrderedDict()
         self._q: "queue.Queue" = queue.Queue()
         self._thread = threading.Thread(target=self._pump, name="event-recorder",
                                         daemon=True)
@@ -78,6 +81,7 @@ class EventRecorder:
                 ev.last_timestamp = _now_iso()
                 self.client.update("events", ev, ns)
                 self._seen[agg_key] = (name, count + 1)
+                self._seen.move_to_end(agg_key)
                 return
             except ApiError:
                 pass  # fall through to create
@@ -90,3 +94,6 @@ class EventRecorder:
             first_timestamp=now, last_timestamp=now, count=1)
         self.client.create("events", ev, ns)
         self._seen[agg_key] = (name, 1)
+        self._seen.move_to_end(agg_key)
+        while len(self._seen) > MAX_AGGREGATION_ENTRIES:
+            self._seen.popitem(last=False)

@@ -143,10 +143,18 @@ class StoreSink:
         self.on_event = on_event
 
     def replace(self, items):
-        self.store.replace({self.key(o): o for o in items})
+        keyed = {self.key(o): o for o in items}
+        # objects deleted during a watch gap must surface as DELETED to the
+        # callback, or consumers' secondary structures go permanently stale
+        vanished = [self.store.get(k) for k in self.store.list_keys()
+                    if k not in keyed]
+        self.store.replace(keyed)
         if self.on_event:
             for o in items:
                 self.on_event("SYNC", o)
+            for o in vanished:
+                if o is not None:
+                    self.on_event("DELETED", o)
 
     def add(self, obj):
         self.store.add(self.key(obj), obj)

@@ -131,17 +131,28 @@ class RESTClient:
         headers = {"User-Agent": self.user_agent}
         if payload is not None:
             headers["Content-Type"] = "application/json"
-        for attempt in (1, 2):  # one retry on a stale keep-alive connection
+        for attempt in (1, 2):
             conn = self._conn()
             try:
                 conn.request(method, path, body=payload, headers=headers)
+            except (http.client.HTTPException, OSError):
+                # send failed before the server saw the request (stale
+                # keep-alive socket) — always safe to retry once
+                self._drop_conn()
+                if attempt == 2:
+                    raise
+                continue
+            try:
                 resp = conn.getresponse()
                 data = resp.read()
                 break
             except (http.client.HTTPException, OSError):
+                # the server may have executed the request; retrying a
+                # non-idempotent verb could double-apply it
                 self._drop_conn()
-                if attempt == 2:
-                    raise
+                if method == "GET" and attempt == 1:
+                    continue
+                raise
         parsed = json.loads(data) if data else {}
         if resp.status >= 400:
             raise ApiError(resp.status, parsed.get("reason", "Unknown"),

@@ -13,7 +13,6 @@ sets spec.nodeName iff empty and flips the PodScheduled condition atomically
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field as dc_field
 from typing import Callable, Dict, List, Optional, Tuple, Type
 
@@ -23,10 +22,7 @@ from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api import validation
 from kubernetes_tpu.api.serialization import from_dict, scheme, to_dict
 from kubernetes_tpu.storage import Conflict, KeyExists, KeyNotFound, MemStore
-
-
-def _now_iso() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+from kubernetes_tpu.utils.timeutil import now_iso as _now_iso
 
 
 @dataclass
@@ -252,24 +248,24 @@ class Registry:
         enforce client preconditions."""
         rd = self._def(resource)
         key = rd.key(namespace, name)
-        for _ in range(max_retries):
-            try:
-                d, rv = self.store.get(key)
-            except KeyNotFound:
-                raise not_found(rd.kind, name) from None
+        result = {}
+
+        def raw_fn(d: dict, rv: int):
             obj = self._decode(rd, d, rv)
             new = fn(obj)
-            if new is None:
-                return obj
-            try:
-                new_rv = self.store.update(key, to_dict(new), expect_rv=rv)
-            except Conflict:
-                continue
-            except KeyNotFound:
-                raise not_found(rd.kind, name) from None
-            new.metadata.resource_version = str(new_rv)
-            return new
-        raise conflict(rd.kind, name, "too much contention")
+            result["obj"] = new if new is not None else obj
+            return None if new is None else to_dict(new)
+
+        try:
+            _, new_rv = self.store.guaranteed_update(key, raw_fn,
+                                                     max_retries=max_retries)
+        except KeyNotFound:
+            raise not_found(rd.kind, name) from None
+        except Conflict as e:
+            raise conflict(rd.kind, name, str(e)) from None
+        out = result["obj"]
+        out.metadata.resource_version = str(new_rv)
+        return out
 
     def delete(self, resource: str, name: str, namespace: str = ""):
         rd = self._def(resource)

@@ -1,0 +1,18 @@
+"""L5 scheduler: shell + Python oracle + TPU decision plane.
+
+Parity target: reference plugin/pkg/scheduler (13.5k LoC) — the complete
+filter-and-score pipeline:
+
+  shell        scheduler.py (loop), factory.py (informers/FIFO/binder/backoff),
+               cache.py (assume/confirm/expire world model)
+  oracle       predicates.py + priorities.py + generic.py — the sequential
+               Python implementation matching the reference's DefaultProvider
+               semantics; the differential reference for the TPU kernel
+  plugin API   provider.py (algorithm providers, policy files),
+               extender.py (HTTP extender)
+  TPU backend  tpu.py — batched filter-and-score over pods x nodes tensors
+               (kubernetes_tpu.ops) behind the same provider boundary
+"""
+
+from kubernetes_tpu.scheduler.cache import NodeInfo, SchedulerCache
+from kubernetes_tpu.scheduler.generic import GenericScheduler, FitError

@@ -1,0 +1,268 @@
+"""The scheduler's world model: per-node aggregates + assume/confirm/expire.
+
+Parity target: reference plugin/pkg/scheduler/schedulercache —
+NodeInfo (node_info.go:32-49: node, requestedResource, nonzeroRequest, pods,
+allowedPodNumber) and the optimistic assume protocol (cache.go:101-127,
+278-308): AssumePod books resources immediately with a TTL; the informer's
+Add for the same pod confirms it (cancels the deadline); if confirmation
+never arrives the assume expires and the booking is rolled back — the system
+self-repairs failed bindings by timeout, not rollback (SURVEY §3.2).
+
+Time is injected everywhere (assume_pod takes `now`) exactly like the
+reference's cache_test.go:536 pattern, so the state machine is testable
+deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.cache import meta_namespace_key
+
+# Non-zero request defaults (reference plugin/pkg/scheduler/algorithm/
+# priorities/util/non_zero.go): pods with no requests still consume
+# *something*; spreading math uses these floors.
+DEFAULT_MILLI_CPU_REQUEST = 100
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
+
+
+class Resource:
+    """Canonical integer resource vector (milliCPU, bytes, gpu count)."""
+
+    __slots__ = ("milli_cpu", "memory", "gpu")
+
+    def __init__(self, milli_cpu: int = 0, memory: int = 0, gpu: int = 0):
+        self.milli_cpu = milli_cpu
+        self.memory = memory
+        self.gpu = gpu
+
+    def __repr__(self):
+        return f"Resource(cpu={self.milli_cpu}m, mem={self.memory}, gpu={self.gpu})"
+
+    def __eq__(self, other):
+        return (self.milli_cpu, self.memory, self.gpu) == (
+            other.milli_cpu, other.memory, other.gpu)
+
+
+def pod_request(pod: api.Pod) -> Resource:
+    r = api.pod_resource_request(pod)
+    return Resource(r[api.RESOURCE_CPU], r[api.RESOURCE_MEMORY],
+                    r[api.RESOURCE_GPU])
+
+
+def pod_nonzero_request(pod: api.Pod) -> Resource:
+    """Requests with per-container floors for cpu/mem (non_zero.go)."""
+    cpu = mem = 0
+    for c in (pod.spec.containers if pod.spec and pod.spec.containers else []):
+        req = (c.resources.requests if c.resources and c.resources.requests else {})
+        from kubernetes_tpu.api.quantity import parse_cpu, parse_quantity
+        ccpu = parse_cpu(req.get(api.RESOURCE_CPU, 0))
+        cmem = parse_quantity(req.get(api.RESOURCE_MEMORY, 0))
+        cpu += ccpu if ccpu else DEFAULT_MILLI_CPU_REQUEST
+        mem += cmem if cmem else DEFAULT_MEMORY_REQUEST
+    return Resource(cpu, mem, 0)
+
+
+class NodeInfo:
+    """Aggregated per-node view (node_info.go:32-49)."""
+
+    def __init__(self, node: Optional[api.Node] = None):
+        self.node: Optional[api.Node] = node
+        self.pods: List[api.Pod] = []
+        self.requested = Resource()
+        self.non_zero_requested = Resource()
+
+    # --- derived -------------------------------------------------------------
+
+    @property
+    def allocatable(self) -> Resource:
+        if self.node is None:
+            return Resource()
+        a = api.node_allocatable(self.node)
+        return Resource(a[api.RESOURCE_CPU], a[api.RESOURCE_MEMORY],
+                        a[api.RESOURCE_GPU])
+
+    @property
+    def allowed_pod_number(self) -> int:
+        if self.node is None:
+            return 0
+        return api.node_allocatable(self.node)[api.RESOURCE_PODS]
+
+    def used_ports(self) -> set:
+        ports = set()
+        for p in self.pods:
+            for c in (p.spec.containers or []) if p.spec else []:
+                for port in c.ports or []:
+                    if port.host_port:
+                        ports.add((port.protocol or "TCP", port.host_port))
+        return ports
+
+    # --- mutation (addPod/removePod, node_info.go:118-156) -------------------
+
+    def add_pod(self, pod: api.Pod):
+        r = pod_request(pod)
+        nz = pod_nonzero_request(pod)
+        self.requested.milli_cpu += r.milli_cpu
+        self.requested.memory += r.memory
+        self.requested.gpu += r.gpu
+        self.non_zero_requested.milli_cpu += nz.milli_cpu
+        self.non_zero_requested.memory += nz.memory
+        self.pods.append(pod)
+
+    def remove_pod(self, pod: api.Pod) -> bool:
+        key = meta_namespace_key(pod)
+        for i, p in enumerate(self.pods):
+            if meta_namespace_key(p) == key:
+                r = pod_request(p)
+                nz = pod_nonzero_request(p)
+                self.requested.milli_cpu -= r.milli_cpu
+                self.requested.memory -= r.memory
+                self.requested.gpu -= r.gpu
+                self.non_zero_requested.milli_cpu -= nz.milli_cpu
+                self.non_zero_requested.memory -= nz.memory
+                del self.pods[i]
+                return True
+        return False
+
+    def clone(self) -> "NodeInfo":
+        ni = NodeInfo(self.node)
+        ni.pods = list(self.pods)
+        ni.requested = Resource(self.requested.milli_cpu, self.requested.memory,
+                                self.requested.gpu)
+        ni.non_zero_requested = Resource(self.non_zero_requested.milli_cpu,
+                                         self.non_zero_requested.memory, 0)
+        return ni
+
+
+class SchedulerCache:
+    """Thread-safe assume/confirm/expire cache (cache.go).
+
+    State machine per pod key:
+      assume_pod    -> assumed (deadline = now+ttl), resources booked
+      add_pod       -> confirmed if assumed (deadline cleared), else added
+      update_pod    -> re-aggregate
+      remove_pod    -> unbooked
+      cleanup(now)  -> expired assumes rolled back
+    """
+
+    def __init__(self, ttl: float = 30.0, clock: Callable[[], float] = _time.monotonic):
+        self._lock = threading.Lock()
+        self.ttl = ttl
+        self._clock = clock
+        self._nodes: Dict[str, NodeInfo] = {}
+        self._assumed: Dict[str, float] = {}   # pod key -> deadline (None=confirmed)
+        self._pod_states: Dict[str, api.Pod] = {}  # key -> pod as last cached
+
+    # --- pods ----------------------------------------------------------------
+
+    def assume_pod(self, pod: api.Pod, now: Optional[float] = None) -> None:
+        """Book the pod's resources on its (just-decided) node immediately,
+        before the binding round-trips (cache.go:101-127)."""
+        key = meta_namespace_key(pod)
+        with self._lock:
+            if key in self._pod_states:
+                raise ValueError(f"pod {key} already in cache")
+            self._add_locked(pod)
+            self._assumed[key] = (now if now is not None else self._clock()) + self.ttl
+
+    def add_pod(self, pod: api.Pod) -> None:
+        """Informer-confirmed add (cache.go AddPod): confirms an assume or
+        adds fresh state."""
+        key = meta_namespace_key(pod)
+        with self._lock:
+            if key in self._assumed:
+                # confirmation: re-aggregate with the authoritative object
+                self._remove_locked(self._pod_states[key])
+                del self._assumed[key]
+                self._add_locked(pod)
+            elif key in self._pod_states:
+                self._remove_locked(self._pod_states[key])
+                self._add_locked(pod)
+            else:
+                self._add_locked(pod)
+
+    def update_pod(self, pod: api.Pod) -> None:
+        self.add_pod(pod)
+
+    def remove_pod(self, pod: api.Pod) -> None:
+        key = meta_namespace_key(pod)
+        with self._lock:
+            cached = self._pod_states.get(key)
+            if cached is not None:
+                self._remove_locked(cached)
+                self._assumed.pop(key, None)
+
+    def is_assumed(self, pod: api.Pod) -> bool:
+        with self._lock:
+            return meta_namespace_key(pod) in self._assumed
+
+    def cleanup_expired(self, now: Optional[float] = None) -> List[str]:
+        """Roll back assumes whose confirmation never arrived
+        (cache.go:278-308 cleanupAssumedPods). Returns expired keys."""
+        now = now if now is not None else self._clock()
+        expired = []
+        with self._lock:
+            for key, deadline in list(self._assumed.items()):
+                if deadline <= now:
+                    self._remove_locked(self._pod_states[key])
+                    del self._assumed[key]
+                    expired.append(key)
+        return expired
+
+    # --- nodes ---------------------------------------------------------------
+
+    def add_node(self, node: api.Node) -> None:
+        with self._lock:
+            ni = self._nodes.get(node.metadata.name)
+            if ni is None:
+                ni = self._nodes[node.metadata.name] = NodeInfo(node)
+            else:
+                ni.node = node
+
+    def update_node(self, node: api.Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, node: api.Node) -> None:
+        with self._lock:
+            ni = self._nodes.get(node.metadata.name)
+            if ni is not None:
+                ni.node = None
+                if not ni.pods:
+                    del self._nodes[node.metadata.name]
+
+    # --- reads ---------------------------------------------------------------
+
+    def get_node_name_to_info_map(self) -> Dict[str, NodeInfo]:
+        """Full snapshot clone under the lock (cache.go:77-85) — the hot-path
+        cost the TPU backend's incremental tensor sync exists to avoid."""
+        with self._lock:
+            return {name: ni.clone() for name, ni in self._nodes.items()}
+
+    def pod_count(self) -> int:
+        with self._lock:
+            return len(self._pod_states)
+
+    # --- internals (lock held) -----------------------------------------------
+
+    def _add_locked(self, pod: api.Pod):
+        node_name = pod.spec.node_name if pod.spec else ""
+        if node_name:
+            ni = self._nodes.get(node_name)
+            if ni is None:
+                # pod observed before its node: keep aggregates anyway
+                ni = self._nodes[node_name] = NodeInfo(None)
+            ni.add_pod(pod)
+        self._pod_states[meta_namespace_key(pod)] = pod
+
+    def _remove_locked(self, pod: api.Pod):
+        node_name = pod.spec.node_name if pod.spec else ""
+        if node_name:
+            ni = self._nodes.get(node_name)
+            if ni is not None:
+                ni.remove_pod(pod)
+                if ni.node is None and not ni.pods:
+                    del self._nodes[node_name]
+        self._pod_states.pop(meta_namespace_key(pod), None)

@@ -1,0 +1,81 @@
+"""HTTP scheduler extender: out-of-process filter/prioritize.
+
+Parity target: reference plugin/pkg/scheduler/extender.go:39-173 — POST
+ExtenderArgs{pod, nodes} to filter/prioritize verbs of an external service;
+this is the plug-in boundary the reference reserves for backends exactly like
+our TPU decision plane (BASELINE.json north star). The TPU backend can run
+either in-process (scheduler/tpu.py) or behind this HTTP seam.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from typing import Dict, List, Tuple
+from urllib.parse import urlparse
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import from_dict, scheme, to_dict
+
+
+class HTTPExtender:
+    def __init__(self, url_prefix: str, filter_verb: str = "filter",
+                 prioritize_verb: str = "prioritize", weight: int = 1,
+                 timeout: float = 5.0):
+        self.url = urlparse(url_prefix)
+        self.filter_verb = filter_verb
+        self.prioritize_verb = prioritize_verb
+        self.weight = weight
+        self.timeout = timeout
+
+    def _post(self, verb: str, payload: dict) -> dict:
+        conn = http.client.HTTPConnection(self.url.hostname, self.url.port,
+                                          timeout=self.timeout)
+        try:
+            path = (self.url.path.rstrip("/") or "") + "/" + verb
+            conn.request("POST", path, body=json.dumps(payload),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"extender {verb} returned {resp.status}")
+            return json.loads(data)
+        finally:
+            conn.close()
+
+    def filter(self, pod: api.Pod,
+               nodes: List[api.Node]) -> Tuple[List[api.Node], Dict[str, str]]:
+        if not self.filter_verb:
+            return nodes, {}
+        payload = {"pod": scheme.encode(pod),
+                   "nodes": {"items": [to_dict(n) for n in nodes]}}
+        result = self._post(self.filter_verb, payload)
+        items = result.get("nodes", {}).get("items", [])
+        kept = [from_dict(api.Node, d) for d in items]
+        failures = {n: f"extender: {r}" for n, r in
+                    (result.get("failedNodes") or {}).items()}
+        return kept, failures
+
+    def prioritize(self, pod: api.Pod, nodes: List[api.Node]) -> Dict[str, int]:
+        if not self.prioritize_verb:
+            return {}
+        payload = {"pod": scheme.encode(pod),
+                   "nodes": {"items": [to_dict(n) for n in nodes]}}
+        result = self._post(self.prioritize_verb, payload)
+        out = {}
+        for entry in result or []:
+            out[entry["host"]] = entry["score"] * self.weight
+        return out
+
+
+def extenders_from_config(configs: List[dict]) -> List[HTTPExtender]:
+    """Build extenders from policy-file entries (api/types.go:114-131)."""
+    out = []
+    for c in configs:
+        out.append(HTTPExtender(
+            url_prefix=c["urlPrefix"],
+            filter_verb=c.get("filterVerb", ""),
+            prioritize_verb=c.get("prioritizeVerb", ""),
+            weight=c.get("weight", 1),
+            timeout=c.get("httpTimeout", 5.0)))
+    return out

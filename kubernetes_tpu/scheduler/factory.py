@@ -1,0 +1,297 @@
+"""ConfigFactory + the scheduler loop: the shell around the algorithm.
+
+Parity target: reference plugin/pkg/scheduler/factory/factory.go (671 ln) and
+scheduler.go (156 ln):
+
+- 8 informer feeds (factory.go:98-150): unassigned pods -> FIFO, assigned
+  pods -> cache, nodes -> cache + lister, services/RCs/RSs/PVs/PVCs -> listers
+- multi-scheduler dispatch by pod's scheduler name (factory.go:426-432)
+- scheduleOne (scheduler.go:93-155): blocking NextPod -> Schedule ->
+  AssumePod (optimistic, 30s TTL) -> async Bind; on error: FailedScheduling
+  event + PodScheduled=False condition + exponential backoff requeue
+  (factory.go:503-539, 1s -> 60s)
+- metrics: e2e/algorithm/binding latency histograms (metrics/metrics.go)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from kubernetes_tpu.api import fields as fieldsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import FIFO, Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.cache import node_name_indexer
+from kubernetes_tpu.client.listers import (
+    ControllerLister, NodeLister, PodLister, ReplicaSetLister, ServiceLister,
+)
+from kubernetes_tpu.client.record import EventRecorder
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.registry.generic import set_pod_condition
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.extender import extenders_from_config
+from kubernetes_tpu.scheduler.generic import FitError, GenericScheduler
+from kubernetes_tpu.scheduler.provider import (
+    DEFAULT_PROVIDER, PluginArgs, get_predicates, get_priorities, get_provider,
+    load_policy,
+)
+from kubernetes_tpu.utils.flowcontrol import Backoff
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+log = logging.getLogger("scheduler")
+
+ASSUME_TTL = 30.0  # factory.go:100
+
+
+class ConfigFactory:
+    """Wires informers, cache, listers and builds a Scheduler."""
+
+    def __init__(self, client: RESTClient,
+                 scheduler_name: str = api.DEFAULT_SCHEDULER_NAME,
+                 hard_pod_affinity_weight: int = 1,
+                 failure_domains=(api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION)):
+        self.client = client
+        self.scheduler_name = scheduler_name
+        self.cache = SchedulerCache(ttl=ASSUME_TTL)
+        self.pending = FIFO()
+        self.backoff = Backoff(initial=1.0, maximum=60.0)  # podBackoff
+        self._informers = []
+
+        # unassigned pods -> FIFO (spec.nodeName= ListWatch, factory.go:458-461)
+        self.unassigned_informer = Informer(ListWatch(
+            client, "pods",
+            field_selector=fieldsel.parse_field_selector("spec.nodeName=")))
+        self.unassigned_informer.add_event_handler(
+            on_add=self._maybe_enqueue,
+            on_update=lambda old, new: self._maybe_enqueue(new),
+            on_delete=lambda p: self.pending.delete(p))
+
+        # assigned pods -> scheduler cache (factory.go:126-137)
+        self.assigned_informer = Informer(
+            ListWatch(client, "pods",
+                      field_selector=fieldsel.parse_field_selector("spec.nodeName!=")),
+            indexers={"node": node_name_indexer})
+        self.assigned_informer.add_event_handler(
+            on_add=self.cache.add_pod,
+            on_update=lambda old, new: self.cache.update_pod(new),
+            on_delete=self.cache.remove_pod)
+
+        # nodes -> cache + lister (factory.go:144-147)
+        self.node_informer = Informer(ListWatch(client, "nodes"))
+        self.node_informer.add_event_handler(
+            on_add=self.cache.add_node,
+            on_update=lambda old, new: self.cache.update_node(new),
+            on_delete=self.cache.remove_node)
+
+        self.service_informer = Informer(ListWatch(client, "services"))
+        self.rc_informer = Informer(ListWatch(client, "replicationcontrollers"))
+        self.rs_informer = Informer(ListWatch(client, "replicasets"))
+        self.pv_informer = Informer(ListWatch(client, "persistentvolumes"))
+        self.pvc_informer = Informer(ListWatch(client, "persistentvolumeclaims"))
+
+        self._informers = [
+            self.unassigned_informer, self.assigned_informer, self.node_informer,
+            self.service_informer, self.rc_informer, self.rs_informer,
+            self.pv_informer, self.pvc_informer,
+        ]
+
+        self.pod_lister = PodLister(self.assigned_informer.store)
+        self.node_lister = NodeLister(self.node_informer.store)
+        self.service_lister = ServiceLister(self.service_informer.store)
+        self.controller_lister = ControllerLister(self.rc_informer.store)
+        self.replicaset_lister = ReplicaSetLister(self.rs_informer.store)
+
+        self.plugin_args = PluginArgs(
+            pod_lister=self.pod_lister,
+            service_lister=self.service_lister,
+            controller_lister=self.controller_lister,
+            replicaset_lister=self.replicaset_lister,
+            node_lookup=lambda name: self.node_informer.store.get(name),
+            pvc_lookup=lambda ns, name: self.pvc_informer.store.get(f"{ns}/{name}"),
+            pv_lookup=lambda name: self.pv_informer.store.get(name),
+            hard_pod_affinity_weight=hard_pod_affinity_weight,
+            failure_domains=tuple(failure_domains),
+        )
+
+    # --- dispatch filter (responsibleForPod, factory.go:426-432) -------------
+
+    def _responsible_for(self, pod: api.Pod) -> bool:
+        return api.get_pod_scheduler_name(pod) == self.scheduler_name
+
+    def _maybe_enqueue(self, pod: api.Pod):
+        if self._responsible_for(pod) and not (pod.spec and pod.spec.node_name):
+            self.pending.add(pod)
+
+    # --- builders (CreateFromProvider/CreateFromConfig, factory.go:248-342) --
+
+    def create_from_provider(self, provider_name: str = DEFAULT_PROVIDER,
+                             algorithm_cls=GenericScheduler) -> "Scheduler":
+        prov = get_provider(provider_name)
+        predicates = get_predicates(prov["predicates"], self.plugin_args)
+        priorities = get_priorities(prov["priorities"], self.plugin_args)
+        return self._create(algorithm_cls(predicates, priorities))
+
+    def create_from_policy(self, policy: dict,
+                           algorithm_cls=GenericScheduler) -> "Scheduler":
+        predicates, priorities, extender_cfgs = load_policy(policy, self.plugin_args)
+        extenders = extenders_from_config(extender_cfgs)
+        return self._create(algorithm_cls(predicates, priorities, extenders))
+
+    def create_from_keys(self, predicate_keys, priority_keys,
+                         algorithm_cls=GenericScheduler) -> "Scheduler":
+        predicates = get_predicates(predicate_keys, self.plugin_args)
+        priorities = get_priorities(priority_keys, self.plugin_args)
+        return self._create(algorithm_cls(predicates, priorities))
+
+    def _create(self, algorithm) -> "Scheduler":
+        return Scheduler(self, algorithm)
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def run(self, wait: bool = True, timeout: float = 10.0):
+        for inf in self._informers:
+            inf.run()
+        if wait:
+            for inf in self._informers:
+                if not inf.wait_for_sync(timeout):
+                    raise TimeoutError("informer failed to sync")
+        return self
+
+    def stop(self):
+        self.pending.close()
+        for inf in self._informers:
+            inf.stop()
+
+
+class Scheduler:
+    """The loop (scheduler.go:89-155)."""
+
+    def __init__(self, factory: ConfigFactory, algorithm):
+        self.f = factory
+        self.algorithm = algorithm
+        self.recorder = EventRecorder(factory.client, "default-scheduler")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._cleanup_thread: Optional[threading.Thread] = None
+
+    # --- one decision (scheduleOne, scheduler.go:93) -------------------------
+
+    def schedule_one(self, timeout: Optional[float] = None) -> bool:
+        """Pop one pending pod and (try to) schedule it. Returns False if the
+        queue timed out / closed."""
+        pod = self.f.pending.pop(timeout=timeout)
+        if pod is None:
+            return False
+        t_start = time.perf_counter()
+        try:
+            info = self.f.cache.get_node_name_to_info_map()
+            nodes = self.f.node_lister.list()
+            with METRICS.time("scheduler_scheduling_algorithm_latency_seconds"):
+                dest = self.algorithm.schedule(pod, info, nodes)
+        except (FitError, Exception) as e:
+            self._handle_failure(pod, e)
+            return True
+        # optimistic assume before the async bind (scheduler.go:120-126)
+        assumed = _with_node(pod, dest)
+        try:
+            self.f.cache.assume_pod(assumed)
+        except ValueError:
+            pass  # already cached (e.g. repeated requeue race); bind anyway
+        threading.Thread(target=self._bind, args=(pod, dest, t_start),
+                         daemon=True).start()
+        return True
+
+    def _bind(self, pod: api.Pod, dest: str, t_start: float):
+        binding = api.Binding(
+            metadata=api.ObjectMeta(name=pod.metadata.name,
+                                    namespace=pod.metadata.namespace),
+            target=api.ObjectReference(kind="Node", name=dest))
+        try:
+            with METRICS.time("scheduler_binding_latency_seconds"):
+                self.f.client.bind(binding, pod.metadata.namespace)
+        except ApiError as e:
+            log.warning("binding failed for %s: %s", pod.metadata.name, e)
+            # roll the assume back immediately; requeue with backoff
+            self.f.cache.remove_pod(_with_node(pod, dest))
+            self._handle_failure(pod, e)
+            return
+        METRICS.observe("scheduler_e2e_scheduling_latency_seconds",
+                        time.perf_counter() - t_start)
+        self.recorder.event(pod, "Normal", "Scheduled",
+                            f"Successfully assigned {pod.metadata.name} to {dest}")
+
+    def _handle_failure(self, pod: api.Pod, err: Exception):
+        """Error func: event + condition + backoff requeue
+        (scheduler.go:102-107, factory.go:503-539)."""
+        log.info("failed to schedule %s: %s", pod.metadata.name, err)
+        self.recorder.event(pod, "Warning", "FailedScheduling", str(err))
+        try:
+            self.f.client.request(
+                "PUT",
+                f"/api/v1/namespaces/{pod.metadata.namespace}/pods/{pod.metadata.name}/status",
+                _status_with_condition(pod, "Unschedulable", str(err)))
+        except ApiError:
+            pass
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        delay = self.f.backoff.next(key)
+
+        def requeue():
+            if self._stop.wait(delay):
+                return
+            try:
+                fresh = self.f.client.get("pods", pod.metadata.name,
+                                          pod.metadata.namespace)
+            except ApiError:
+                return  # deleted meanwhile
+            if not (fresh.spec and fresh.spec.node_name):
+                self.f.pending.add_if_not_present(fresh)
+
+        threading.Thread(target=requeue, daemon=True).start()
+
+    # --- loop ----------------------------------------------------------------
+
+    def run(self):
+        self._thread = threading.Thread(target=self._loop, name="scheduler",
+                                        daemon=True)
+        self._thread.start()
+        self._cleanup_thread = threading.Thread(target=self._cleanup_loop,
+                                                name="scheduler-cache-gc",
+                                                daemon=True)
+        self._cleanup_thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.schedule_one(timeout=0.5)
+            except Exception:
+                log.exception("scheduleOne crashed")  # HandleCrash
+
+    def _cleanup_loop(self):
+        while not self._stop.wait(1.0):
+            self.f.cache.cleanup_expired()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def _with_node(pod: api.Pod, node_name: str) -> api.Pod:
+    from kubernetes_tpu.api.serialization import deep_copy
+    p = deep_copy(pod)
+    p.spec.node_name = node_name
+    return p
+
+
+def _status_with_condition(pod: api.Pod, reason: str, message: str) -> dict:
+    from kubernetes_tpu.api.serialization import scheme, deep_copy
+    p = deep_copy(pod)
+    if p.status is None:
+        p.status = api.PodStatus()
+    set_pod_condition(p, api.POD_SCHEDULED, api.CONDITION_FALSE, reason, message)
+    # don't carry a stale rv into the status CAS precondition
+    p.metadata.resource_version = ""
+    return scheme.encode(p)

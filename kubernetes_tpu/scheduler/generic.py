@@ -1,0 +1,157 @@
+"""The generic scheduler: filter -> score -> select.
+
+Parity target: reference plugin/pkg/scheduler/generic_scheduler.go —
+Schedule() (:70-114): list nodes, snapshot cache, findNodesThatFit (:137,
+16-way parallel in Go; a thread pool here), extender filters (:164-175),
+PrioritizeNodes (:220-305, weighted sum), selectHost (:116-133, sort desc +
+round-robin among max-score ties).
+
+The oracle path runs these sequentially per pod; the TPU backend computes the
+same mask/score matrices batched (ops/) and must agree bit-for-bit — ties are
+resolved against a canonical node order (the node list order) since the Go
+implementation's own tie order is map-iteration dependent (SURVEY §7 "hard
+parts" #1: we match the *set* of valid outcomes with a deterministic choice).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.cache import NodeInfo
+from kubernetes_tpu.scheduler.predicates import PredicateFailure
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+from kubernetes_tpu.utils.trace import Trace
+
+PARALLEL_WORKERS = 16  # generic_scheduler.go:159 workqueue.Parallelize(16, ...)
+
+
+class FitError(Exception):
+    """No node fits; carries per-node failure reasons
+    (generic_scheduler.go:40-67)."""
+
+    def __init__(self, pod: api.Pod, failed_predicates: Dict[str, str]):
+        self.pod = pod
+        self.failed_predicates = failed_predicates
+        name = pod.metadata.name if pod.metadata else "?"
+        super().__init__(
+            f"pod ({name}) failed to fit in any node: "
+            + "; ".join(f"{n}: {r}" for n, r in sorted(failed_predicates.items())[:5]))
+
+
+class PriorityConfig:
+    def __init__(self, function: Callable, weight: int = 1, name: str = ""):
+        assert weight >= 0
+        self.function = function
+        self.weight = weight
+        self.name = name or getattr(function, "__name__", "priority")
+
+
+class GenericScheduler:
+    def __init__(self, predicates: Dict[str, Callable],
+                 priorities: List[PriorityConfig],
+                 extenders: Optional[list] = None,
+                 parallel: bool = True):
+        self.predicates = predicates
+        self.priorities = priorities
+        self.extenders = extenders or []
+        self._last_node_index = 0  # selectHost round-robin state (:37)
+        self._pool = ThreadPoolExecutor(max_workers=PARALLEL_WORKERS) if parallel else None
+
+    # --- Schedule (generic_scheduler.go:70) ----------------------------------
+
+    def schedule(self, pod: api.Pod, info: Dict[str, NodeInfo],
+                 nodes: List[api.Node]) -> str:
+        trace = Trace("Scheduling", pod=(pod.metadata.name if pod.metadata else "?"))
+        if not nodes:
+            raise FitError(pod, {"": "no nodes available to schedule pods"})
+        with trace.step("Computing predicates"):
+            fit_nodes, failures = self.find_nodes_that_fit(pod, info, nodes)
+        if not fit_nodes:
+            raise FitError(pod, failures)
+        with trace.step("Prioritizing"):
+            scores = self.prioritize_nodes(pod, info, fit_nodes)
+        with trace.step("Selecting host"):
+            host = self.select_host(scores, fit_nodes)
+        trace.log_if_slow(0.020)  # 20ms threshold (generic_scheduler.go:77)
+        return host
+
+    # --- filter (findNodesThatFit, :137) -------------------------------------
+
+    def find_nodes_that_fit(self, pod: api.Pod, info: Dict[str, NodeInfo],
+                            nodes: List[api.Node]
+                            ) -> Tuple[List[api.Node], Dict[str, str]]:
+        failures: Dict[str, str] = {}
+        lock = threading.Lock()
+
+        def check(node: api.Node) -> Optional[api.Node]:
+            ni = info.get(node.metadata.name) or NodeInfo(node)
+            for name, pred in self.predicates.items():
+                try:
+                    pred(pod, ni)
+                except PredicateFailure as e:
+                    with lock:
+                        failures[node.metadata.name] = f"{name}: {e.reason}"
+                    return None
+            return node
+
+        if self._pool is not None and len(nodes) > 1:
+            results = list(self._pool.map(check, nodes))
+        else:
+            results = [check(n) for n in nodes]
+        fit = [n for n in results if n is not None]
+        # extender filters run serially after local predicates (:164-175)
+        for ext in self.extenders:
+            if not fit:
+                break
+            fit, ext_failures = ext.filter(pod, fit)
+            failures.update(ext_failures)
+        return fit, failures
+
+    # --- score (PrioritizeNodes, :220) ---------------------------------------
+
+    def prioritize_nodes(self, pod: api.Pod, info: Dict[str, NodeInfo],
+                         nodes: List[api.Node]) -> Dict[str, int]:
+        if not self.priorities and not self.extenders:
+            return {n.metadata.name: 1 for n in nodes}
+        combined: Dict[str, int] = {n.metadata.name: 0 for n in nodes}
+        lock = threading.Lock()
+
+        def run_one(cfg: PriorityConfig):
+            if cfg.weight == 0:
+                return
+            scores = cfg.function(pod, info, nodes)
+            with lock:
+                for name, s in scores.items():
+                    if name in combined:
+                        combined[name] += s * cfg.weight
+
+        if self._pool is not None and len(self.priorities) > 1:
+            list(self._pool.map(run_one, self.priorities))
+        else:
+            for cfg in self.priorities:
+                run_one(cfg)
+        for ext in self.extenders:
+            ext_scores = ext.prioritize(pod, nodes)
+            for name, s in ext_scores.items():
+                if name in combined:
+                    combined[name] += s
+        return combined
+
+    # --- select (selectHost, :116-133) ---------------------------------------
+
+    def select_host(self, scores: Dict[str, int], nodes: List[api.Node]) -> str:
+        """Max score wins; ties broken round-robin over the canonical node
+        order with persistent state, mirroring lastNodeIndex (:118-133)."""
+        if not scores:
+            raise ValueError("empty priority list")
+        max_score = max(scores.values())
+        best = [n.metadata.name for n in nodes
+                if scores.get(n.metadata.name, 0) == max_score]
+        if not best:  # scores for nodes not in list (extender edge); fallback
+            best = sorted(k for k, v in scores.items() if v == max_score)
+        idx = self._last_node_index % len(best)
+        self._last_node_index += 1
+        return best[idx]

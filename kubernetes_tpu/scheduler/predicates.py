@@ -1,0 +1,473 @@
+"""The filter stage: FitPredicate functions.
+
+Parity target: reference plugin/pkg/scheduler/algorithm/predicates/
+predicates.go (1,030 ln). Each predicate is `fn(pod, node_info) -> None` and
+raises PredicateFailure (with a reason) on mismatch — Python's idiomatic
+version of the reference's `(bool, error)` returns and error taxonomy
+(error.go: InsufficientResourceError / PredicateFailureError).
+
+Complete predicate inventory (SURVEY §2.5) with reference anchors:
+  pod_fits_resources        predicates.go:416-451
+  pod_fits_host             predicates.go:533
+  pod_fits_host_ports       predicates.go:687
+  pod_matches_node_selector predicates.go:470-531 (nodeSelector ∧ NodeAffinity)
+  general_predicates        predicates.go:733 (bundle of the four above)
+  no_disk_conflict          predicates.go:105 (GCE-PD / EBS / RBD clash)
+  max_pd_volume_count       predicates.go:137-269 (EBS<=39 / GCE<=16)
+  volume_zone               predicates.go:271-347 (PV zone labels vs node)
+  node_label_presence       predicates.go:552
+  service_affinity          predicates.go:596-685
+  inter_pod_affinity        predicates.go:769-947 (hard affinity/anti-affinity
+                            incl. symmetry with existing pods' rules)
+  pod_tolerates_node_taints predicates.go:960-1002
+  check_node_memory_pressure predicates.go:1011 (BestEffort QoS gate)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.cache import NodeInfo, pod_request
+
+DEFAULT_MAX_EBS_VOLUMES = 39
+DEFAULT_MAX_GCE_PD_VOLUMES = 16
+
+
+class PredicateFailure(Exception):
+    """A pod does not fit a node, with the reason the reference reports."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(reason)
+
+
+class InsufficientResource(PredicateFailure):
+    def __init__(self, resource: str, requested: int, used: int, capacity: int):
+        self.resource = resource
+        self.requested = requested
+        self.used = used
+        self.capacity = capacity
+        super().__init__(
+            f"Insufficient {resource}: requested {requested}, used {used}, "
+            f"capacity {capacity}")
+
+
+# --- resources ----------------------------------------------------------------
+
+def pod_fits_resources(pod: api.Pod, node_info: NodeInfo) -> None:
+    """cpu/mem/gpu requests + pod-count vs Allocatable (predicates.go:416)."""
+    node = _require_node(node_info)
+    allowed = node_info.allowed_pod_number
+    if len(node_info.pods) + 1 > allowed:
+        raise InsufficientResource("pods", 1, len(node_info.pods), allowed)
+    req = pod_request(pod)
+    if req.milli_cpu == 0 and req.memory == 0 and req.gpu == 0:
+        return
+    alloc = node_info.allocatable
+    used = node_info.requested
+    if used.milli_cpu + req.milli_cpu > alloc.milli_cpu:
+        raise InsufficientResource("cpu", req.milli_cpu, used.milli_cpu, alloc.milli_cpu)
+    if used.memory + req.memory > alloc.memory:
+        raise InsufficientResource("memory", req.memory, used.memory, alloc.memory)
+    if used.gpu + req.gpu > alloc.gpu:
+        raise InsufficientResource("gpu", req.gpu, used.gpu, alloc.gpu)
+
+
+# --- host / ports -------------------------------------------------------------
+
+def pod_fits_host(pod: api.Pod, node_info: NodeInfo) -> None:
+    """spec.nodeName, when pre-set, must name this node (predicates.go:533)."""
+    want = pod.spec.node_name if pod.spec else ""
+    if want and want != _require_node(node_info).metadata.name:
+        raise PredicateFailure(f"pod wants node {want}")
+
+
+def pod_host_ports(pod: api.Pod) -> Set[tuple]:
+    ports = set()
+    for c in (pod.spec.containers or []) if pod.spec else []:
+        for p in c.ports or []:
+            if p.host_port:
+                ports.add((p.protocol or "TCP", p.host_port))
+    return ports
+
+
+def pod_fits_host_ports(pod: api.Pod, node_info: NodeInfo) -> None:
+    """Requested hostPorts must be free on the node (predicates.go:687)."""
+    wanted = pod_host_ports(pod)
+    if wanted and wanted & node_info.used_ports():
+        clash = sorted(wanted & node_info.used_ports())
+        raise PredicateFailure(f"host port(s) in use: {clash}")
+
+
+# --- node selector / node affinity -------------------------------------------
+
+def _term_matches_node(term: api.NodeSelectorTerm, node: api.Node) -> bool:
+    """A NodeSelectorTerm is an AND of expressions (predicates.go
+    nodeMatchesNodeSelectorTerms helper semantics)."""
+    node_labels = (node.metadata.labels or {}) if node.metadata else {}
+    for expr in term.match_expressions or []:
+        req = labelsel.Requirement(expr.key, expr.operator,
+                                   tuple(expr.values or ()))
+        if not req.matches(node_labels):
+            return False
+    return True
+
+
+def pod_matches_node_selector(pod: api.Pod, node_info: NodeInfo) -> None:
+    """nodeSelector AND NodeAffinity.requiredDuringScheduling
+    (predicates.go:470-531 PodSelectorMatches/podMatchesNodeLabels)."""
+    node = _require_node(node_info)
+    node_labels = (node.metadata.labels or {}) if node.metadata else {}
+    if pod.spec and pod.spec.node_selector:
+        if not labelsel.selector_from_map(pod.spec.node_selector).matches(node_labels):
+            raise PredicateFailure("node selector mismatch")
+    aff = pod.spec.affinity if pod.spec else None
+    na = aff.node_affinity if aff else None
+    req = na.required_during_scheduling_ignored_during_execution if na else None
+    if req is not None:
+        terms = req.node_selector_terms or []
+        # nil/empty terms match nothing (reference NodeSelectorRequirementsAsSelector)
+        if not any(_term_matches_node(t, node) for t in terms):
+            raise PredicateFailure("node affinity mismatch")
+
+
+# --- volumes ------------------------------------------------------------------
+
+def _volume_conflict(v: api.Volume, existing: api.Volume) -> bool:
+    """Same GCE PD (unless both read-only), same EBS volume, or same RBD
+    image => conflict (predicates.go:64-103 isVolumeConflict)."""
+    if v.gce_persistent_disk and existing.gce_persistent_disk:
+        a, b = v.gce_persistent_disk, existing.gce_persistent_disk
+        if a.pd_name == b.pd_name and not (a.read_only and b.read_only):
+            return True
+    if v.aws_elastic_block_store and existing.aws_elastic_block_store:
+        if v.aws_elastic_block_store.volume_id == existing.aws_elastic_block_store.volume_id:
+            return True
+    if v.rbd and existing.rbd:
+        a, b = v.rbd, existing.rbd
+        if a.pool == b.pool and a.image == b.image and set(a.monitors or []) & set(b.monitors or []):
+            return True
+    return False
+
+
+def no_disk_conflict(pod: api.Pod, node_info: NodeInfo) -> None:
+    for v in (pod.spec.volumes or []) if pod.spec else []:
+        for ep in node_info.pods:
+            for ev in (ep.spec.volumes or []) if ep.spec else []:
+                if _volume_conflict(v, ev):
+                    raise PredicateFailure(f"disk conflict on volume {v.name}")
+
+
+class MaxPDVolumeCountChecker:
+    """Cloud-attach limits: count the node's unique attachable volumes of one
+    family including the incoming pod's (predicates.go:137-269). PVC-backed
+    volumes resolve through a PVC->PV lookup."""
+
+    def __init__(self, family: str, max_volumes: int,
+                 pvc_lookup: Optional[Callable[[str, str], Optional[api.PersistentVolumeClaim]]] = None,
+                 pv_lookup: Optional[Callable[[str], Optional[api.PersistentVolume]]] = None):
+        assert family in ("ebs", "gce-pd")
+        self.family = family
+        self.max_volumes = max_volumes
+        self.pvc_lookup = pvc_lookup
+        self.pv_lookup = pv_lookup
+
+    def _volume_id(self, v: api.Volume, namespace: str) -> Optional[str]:
+        if self.family == "ebs" and v.aws_elastic_block_store:
+            return v.aws_elastic_block_store.volume_id
+        if self.family == "gce-pd" and v.gce_persistent_disk:
+            return v.gce_persistent_disk.pd_name
+        if v.persistent_volume_claim and self.pvc_lookup:
+            pvc = self.pvc_lookup(namespace, v.persistent_volume_claim.claim_name)
+            if pvc and pvc.spec and pvc.spec.volume_name and self.pv_lookup:
+                pv = self.pv_lookup(pvc.spec.volume_name)
+                if pv and pv.spec:
+                    if self.family == "ebs" and pv.spec.aws_elastic_block_store:
+                        return pv.spec.aws_elastic_block_store.volume_id
+                    if self.family == "gce-pd" and pv.spec.gce_persistent_disk:
+                        return pv.spec.gce_persistent_disk.pd_name
+        return None
+
+    def __call__(self, pod: api.Pod, node_info: NodeInfo) -> None:
+        ns = pod.metadata.namespace if pod.metadata else ""
+        new_ids = {vid for v in ((pod.spec.volumes or []) if pod.spec else [])
+                   if (vid := self._volume_id(v, ns)) is not None}
+        if not new_ids:
+            return
+        existing: Set[str] = set()
+        for ep in node_info.pods:
+            ens = ep.metadata.namespace if ep.metadata else ""
+            for v in (ep.spec.volumes or []) if ep.spec else []:
+                vid = self._volume_id(v, ens)
+                if vid is not None:
+                    existing.add(vid)
+        if len(existing | new_ids) > self.max_volumes:
+            raise PredicateFailure(
+                f"exceeds max {self.family} volume count {self.max_volumes}")
+
+
+class VolumeZoneChecker:
+    """PVs carry zone/region labels; the node must match them
+    (predicates.go:271-347)."""
+
+    def __init__(self, pvc_lookup, pv_lookup):
+        self.pvc_lookup = pvc_lookup
+        self.pv_lookup = pv_lookup
+
+    def __call__(self, pod: api.Pod, node_info: NodeInfo) -> None:
+        node = _require_node(node_info)
+        node_labels = (node.metadata.labels or {}) if node.metadata else {}
+        ns = pod.metadata.namespace if pod.metadata else ""
+        for v in (pod.spec.volumes or []) if pod.spec else []:
+            if not v.persistent_volume_claim:
+                continue
+            pvc = self.pvc_lookup(ns, v.persistent_volume_claim.claim_name)
+            if pvc is None:
+                raise PredicateFailure(
+                    f"PVC {v.persistent_volume_claim.claim_name} not found")
+            if not (pvc.spec and pvc.spec.volume_name):
+                raise PredicateFailure(f"PVC {pvc.metadata.name} not bound")
+            pv = self.pv_lookup(pvc.spec.volume_name)
+            if pv is None:
+                raise PredicateFailure(f"PV {pvc.spec.volume_name} not found")
+            pv_labels = (pv.metadata.labels or {}) if pv.metadata else {}
+            for key in (api.LABEL_ZONE, api.LABEL_REGION):
+                want = pv_labels.get(key)
+                if want and node_labels.get(key) != want:
+                    raise PredicateFailure(
+                        f"volume zone mismatch: PV wants {key}={want}")
+
+
+# --- labels / service affinity ------------------------------------------------
+
+class NodeLabelChecker:
+    """Require labels present (or absent) on every node
+    (predicates.go:552 NodeLabelChecker)."""
+
+    def __init__(self, labels: List[str], presence: bool):
+        self.labels = labels
+        self.presence = presence
+
+    def __call__(self, pod: api.Pod, node_info: NodeInfo) -> None:
+        node = _require_node(node_info)
+        node_labels = (node.metadata.labels or {}) if node.metadata else {}
+        for l in self.labels:
+            if (l in node_labels) != self.presence:
+                raise PredicateFailure(
+                    f"node label {l} {'absent' if self.presence else 'present'}")
+
+
+class ServiceAffinity:
+    """Pods of the same service must land on nodes agreeing on the given
+    label keys (predicates.go:596-685)."""
+
+    def __init__(self, pod_lister, service_lister, node_lookup,
+                 labels: List[str]):
+        self.pod_lister = pod_lister
+        self.service_lister = service_lister
+        self.node_lookup = node_lookup  # name -> Node
+        self.labels = labels
+
+    def __call__(self, pod: api.Pod, node_info: NodeInfo) -> None:
+        node = _require_node(node_info)
+        node_labels = (node.metadata.labels or {}) if node.metadata else {}
+        # if the pod itself nodeSelector-pins every affinity label, use those
+        wanted: Dict[str, str] = {}
+        sel = (pod.spec.node_selector or {}) if pod.spec else {}
+        if all(l in sel for l in self.labels):
+            wanted = {l: sel[l] for l in self.labels}
+        else:
+            # otherwise adopt the labels of nodes running this service's pods
+            services = self.service_lister.get_pod_services(pod)
+            if services:
+                svc_sel = labelsel.selector_from_map(services[0].spec.selector)
+                ns = pod.metadata.namespace
+                peers = [p for p in self.pod_lister.list(svc_sel)
+                         if p.metadata.namespace == ns and p.spec and p.spec.node_name]
+                if peers:
+                    peer_node = self.node_lookup(peers[0].spec.node_name)
+                    if peer_node is not None:
+                        peer_labels = (peer_node.metadata.labels or {})
+                        wanted = {l: peer_labels.get(l, "") for l in self.labels}
+        for l, v in wanted.items():
+            if node_labels.get(l, "") != v:
+                raise PredicateFailure(f"service affinity: needs {l}={v!r}")
+
+
+# --- taints -------------------------------------------------------------------
+
+def node_taints(node: api.Node) -> List[api.Taint]:
+    return (node.spec.taints or []) if node.spec else []
+
+
+def pod_tolerations(pod: api.Pod) -> List[api.Toleration]:
+    return (pod.spec.tolerations or []) if pod.spec else []
+
+
+def pod_tolerates_node_taints(pod: api.Pod, node_info: NodeInfo) -> None:
+    """Every NoSchedule taint must be tolerated (predicates.go:960-1002)."""
+    node = _require_node(node_info)
+    tolerations = pod_tolerations(pod)
+    for taint in node_taints(node):
+        if taint.effect != api.TAINT_NO_SCHEDULE:
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            raise PredicateFailure(
+                f"untolerated taint {taint.key}={taint.value}:{taint.effect}")
+
+
+# --- memory pressure ----------------------------------------------------------
+
+def is_best_effort(pod: api.Pod) -> bool:
+    """BestEffort QoS: no container requests or limits at all (reference
+    pkg/kubelet/qos semantics used by predicates.go:1011)."""
+    for c in (pod.spec.containers or []) if pod.spec else []:
+        if c.resources and (c.resources.requests or c.resources.limits):
+            return False
+    return True
+
+
+def check_node_memory_pressure(pod: api.Pod, node_info: NodeInfo) -> None:
+    """BestEffort pods don't schedule onto nodes reporting MemoryPressure
+    (predicates.go:1011)."""
+    if not is_best_effort(pod):
+        return
+    node = _require_node(node_info)
+    for cond in (node.status.conditions or []) if node.status else []:
+        if cond.type == api.NODE_MEMORY_PRESSURE and cond.status == api.CONDITION_TRUE:
+            raise PredicateFailure("node has memory pressure")
+
+
+# --- inter-pod affinity -------------------------------------------------------
+
+def _term_namespaces(pod: api.Pod, term: api.PodAffinityTerm) -> Optional[Set[str]]:
+    """None namespaces => pod's own namespace; empty list => all namespaces
+    (reference GetNamespacesFromPodAffinityTerm, non_zero.go:76)."""
+    if term.namespaces is None:
+        return {pod.metadata.namespace}
+    if len(term.namespaces) == 0:
+        return None  # all
+    return set(term.namespaces)
+
+
+def _pod_matches_term(candidate: api.Pod, owner: api.Pod,
+                      term: api.PodAffinityTerm) -> bool:
+    """Does `candidate` match `owner`'s affinity term (namespace + selector)?
+    (reference CheckIfPodMatchPodAffinityTerm, non_zero.go:114 — minus the
+    topology check, applied by callers)."""
+    names = _term_namespaces(owner, term)
+    if names is not None and candidate.metadata.namespace not in names:
+        return False
+    sel = labelsel.selector_from_label_selector(term.label_selector)
+    return sel.matches((candidate.metadata.labels or {}))
+
+
+def _same_topology(node_a: Optional[api.Node], node_b: Optional[api.Node],
+                   topology_key: str, default_keys=()) -> bool:
+    """Nodes share a topology domain iff both carry the key with equal,
+    non-empty values (non_zero.go:87-109). Empty key: any default key."""
+    if node_a is None or node_b is None:
+        return False
+    la = (node_a.metadata.labels or {}) if node_a.metadata else {}
+    lb = (node_b.metadata.labels or {}) if node_b.metadata else {}
+    keys = [topology_key] if topology_key else list(default_keys)
+    for k in keys:
+        if la.get(k) and la.get(k) == lb.get(k):
+            return True
+    return False
+
+
+class InterPodAffinity:
+    """Hard inter-pod affinity + anti-affinity with symmetry
+    (predicates.go:769-947). O(nodes x pods x terms) in the oracle; the TPU
+    backend turns this into masked label-bitset matmuls."""
+
+    def __init__(self, pod_lister, node_lookup,
+                 failure_domains=(api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION)):
+        self.pod_lister = pod_lister
+        self.node_lookup = node_lookup  # name -> Node
+        self.failure_domains = tuple(failure_domains)
+
+    def _any_pod_matches(self, pod: api.Pod, all_pods, node: api.Node,
+                         term: api.PodAffinityTerm) -> bool:
+        """AnyPodMatchesPodAffinityTerm (predicates.go:785): some existing
+        pod matches the term AND sits in the same topology domain as `node`."""
+        for ep in all_pods:
+            if not (ep.spec and ep.spec.node_name):
+                continue
+            if not _pod_matches_term(ep, pod, term):
+                continue
+            ep_node = self.node_lookup(ep.spec.node_name)
+            if _same_topology(ep_node, node, term.topology_key, self.failure_domains):
+                return True
+        return False
+
+    def _check_affinity(self, pod, all_pods, node, terms) -> None:
+        for term in terms:
+            if self._any_pod_matches(pod, all_pods, node, term):
+                continue
+            # the disregard rule (predicates.go:818-844): if the term selects
+            # the pod's own labels and NO existing pod anywhere matches it,
+            # the first pod of a self-affine group may schedule
+            if not _pod_matches_term(pod, pod, term):
+                raise PredicateFailure("pod affinity not satisfied")
+            for ep in all_pods:
+                if _pod_matches_term(ep, pod, term):
+                    raise PredicateFailure("pod affinity not satisfied")
+            # disregarded: self-selecting term with no matches anywhere
+
+    def _check_anti_affinity(self, pod, all_pods, node, terms) -> None:
+        for term in terms:
+            if self._any_pod_matches(pod, all_pods, node, term):
+                raise PredicateFailure("pod anti-affinity violated")
+
+    def _check_symmetry(self, pod, all_pods, node) -> None:
+        """Existing pods' anti-affinity terms must not match the incoming pod
+        within their topology (predicates.go:883-921)."""
+        for ep in all_pods:
+            ep_aff = ep.spec.affinity if ep.spec else None
+            ep_anti = ep_aff.pod_anti_affinity if ep_aff else None
+            terms = (ep_anti.required_during_scheduling_ignored_during_execution
+                     or []) if ep_anti else []
+            if not terms:
+                continue
+            for term in terms:
+                if not _pod_matches_term(pod, ep, term):
+                    continue
+                ep_node = self.node_lookup(ep.spec.node_name) if ep.spec and ep.spec.node_name else None
+                if _same_topology(ep_node, node, term.topology_key, self.failure_domains):
+                    raise PredicateFailure(
+                        "existing pod's anti-affinity forbids this pod here")
+
+    def __call__(self, pod: api.Pod, node_info: NodeInfo) -> None:
+        node = _require_node(node_info)
+        aff = pod.spec.affinity if pod.spec else None
+        all_pods = self.pod_lister.list()
+        if aff and aff.pod_affinity:
+            self._check_affinity(
+                pod, all_pods, node,
+                aff.pod_affinity.required_during_scheduling_ignored_during_execution or [])
+        if aff and aff.pod_anti_affinity:
+            self._check_anti_affinity(
+                pod, all_pods, node,
+                aff.pod_anti_affinity.required_during_scheduling_ignored_during_execution or [])
+        self._check_symmetry(pod, all_pods, node)
+
+
+# --- bundles ------------------------------------------------------------------
+
+def general_predicates(pod: api.Pod, node_info: NodeInfo) -> None:
+    """The kubelet re-checks exactly this bundle at admission
+    (predicates.go:733 GeneralPredicates)."""
+    pod_fits_resources(pod, node_info)
+    pod_fits_host(pod, node_info)
+    pod_fits_host_ports(pod, node_info)
+    pod_matches_node_selector(pod, node_info)
+
+
+def _require_node(node_info: NodeInfo) -> api.Node:
+    if node_info.node is None:
+        raise PredicateFailure("node not found")
+    return node_info.node

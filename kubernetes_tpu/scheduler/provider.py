@@ -1,0 +1,203 @@
+"""Algorithm provider registry + policy file API.
+
+Parity target: reference plugin/pkg/scheduler/factory/plugins.go (the
+RegisterFitPredicate / RegisterPriorityConfigFactory / RegisterAlgorithmProvider
+registry), algorithmprovider/defaults/defaults.go:55-197 (DefaultProvider
+contents), and the versioned policy-file API
+(plugin/pkg/scheduler/api/types.go:27-173) loaded via --policy-config-file
+with its restricted custom predicate/priority argument forms
+(ServiceAffinity/LabelsPresence and ServiceAntiAffinity/LabelPreference).
+
+Factories take a PluginArgs carrying the listers the plugin needs, so
+registration order is decoupled from informer wiring (the reference's
+PluginFactoryArgs pattern).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler import predicates as preds
+from kubernetes_tpu.scheduler import priorities as prios
+from kubernetes_tpu.scheduler.generic import PriorityConfig
+
+
+@dataclass
+class PluginArgs:
+    """What plugin factories may depend on (PluginFactoryArgs)."""
+
+    pod_lister: object = None
+    service_lister: object = None
+    controller_lister: object = None
+    replicaset_lister: object = None
+    node_lookup: Callable = None           # name -> Node
+    pvc_lookup: Callable = None            # (ns, name) -> PVC
+    pv_lookup: Callable = None             # name -> PV
+    hard_pod_affinity_weight: int = 1
+    failure_domains: tuple = (api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION)
+
+
+_PREDICATE_FACTORIES: Dict[str, Callable] = {}
+_PRIORITY_FACTORIES: Dict[str, Callable] = {}  # name -> (args) -> PriorityConfig
+_PROVIDERS: Dict[str, dict] = {}
+
+
+def register_fit_predicate(name: str, factory: Callable):
+    _PREDICATE_FACTORIES[name] = factory
+    return name
+
+
+def register_priority(name: str, weight: int, factory: Callable):
+    def mk(args: PluginArgs, w: int = weight) -> PriorityConfig:
+        return PriorityConfig(factory(args), weight=w, name=name)
+
+    _PRIORITY_FACTORIES[name] = mk
+    return name
+
+
+def register_algorithm_provider(name: str, predicate_keys: List[str],
+                                priority_keys: List[str]):
+    _PROVIDERS[name] = {"predicates": list(predicate_keys),
+                        "priorities": list(priority_keys)}
+    return name
+
+
+def get_predicates(keys: List[str], args: PluginArgs) -> Dict[str, Callable]:
+    out = {}
+    for k in keys:
+        if k not in _PREDICATE_FACTORIES:
+            raise KeyError(f"unknown fit predicate {k!r}")
+        out[k] = _PREDICATE_FACTORIES[k](args)
+    return out
+
+
+def get_priorities(keys: List[str], args: PluginArgs,
+                   weights: Optional[Dict[str, int]] = None) -> List[PriorityConfig]:
+    out = []
+    for k in keys:
+        if k not in _PRIORITY_FACTORIES:
+            raise KeyError(f"unknown priority {k!r}")
+        cfg = _PRIORITY_FACTORIES[k](args)
+        if weights and k in weights:
+            cfg.weight = weights[k]
+        out.append(cfg)
+    return out
+
+
+def get_provider(name: str) -> dict:
+    if name not in _PROVIDERS:
+        raise KeyError(f"unknown algorithm provider {name!r}")
+    return _PROVIDERS[name]
+
+
+# --- built-in registrations (defaults.go:55-197) ------------------------------
+
+register_fit_predicate("PodFitsResources", lambda a: preds.pod_fits_resources)
+register_fit_predicate("PodFitsHost", lambda a: preds.pod_fits_host)
+register_fit_predicate("PodFitsHostPorts", lambda a: preds.pod_fits_host_ports)
+register_fit_predicate("MatchNodeSelector", lambda a: preds.pod_matches_node_selector)
+register_fit_predicate("GeneralPredicates", lambda a: preds.general_predicates)
+register_fit_predicate("NoDiskConflict", lambda a: preds.no_disk_conflict)
+register_fit_predicate(
+    "MaxEBSVolumeCount",
+    lambda a: preds.MaxPDVolumeCountChecker(
+        "ebs", preds.DEFAULT_MAX_EBS_VOLUMES, a.pvc_lookup, a.pv_lookup))
+register_fit_predicate(
+    "MaxGCEPDVolumeCount",
+    lambda a: preds.MaxPDVolumeCountChecker(
+        "gce-pd", preds.DEFAULT_MAX_GCE_PD_VOLUMES, a.pvc_lookup, a.pv_lookup))
+register_fit_predicate(
+    "NoVolumeZoneConflict",
+    lambda a: (preds.VolumeZoneChecker(a.pvc_lookup, a.pv_lookup)
+               if a.pvc_lookup and a.pv_lookup else _noop_predicate))
+register_fit_predicate("PodToleratesNodeTaints",
+                       lambda a: preds.pod_tolerates_node_taints)
+register_fit_predicate("CheckNodeMemoryPressure",
+                       lambda a: preds.check_node_memory_pressure)
+register_fit_predicate(
+    "MatchInterPodAffinity",
+    lambda a: preds.InterPodAffinity(a.pod_lister, a.node_lookup,
+                                     a.failure_domains))
+
+register_priority("LeastRequestedPriority", 1, lambda a: prios.least_requested)
+register_priority("BalancedResourceAllocation", 1,
+                  lambda a: prios.balanced_resource_allocation)
+register_priority("SelectorSpreadPriority", 1,
+                  lambda a: prios.SelectorSpread(a.service_lister,
+                                                 a.controller_lister,
+                                                 a.replicaset_lister))
+register_priority("NodeAffinityPriority", 1, lambda a: prios.node_affinity_priority)
+register_priority("TaintTolerationPriority", 1,
+                  lambda a: prios.taint_toleration_priority)
+register_priority(
+    "InterPodAffinityPriority", 1,
+    lambda a: prios.InterPodAffinityPriority(a.pod_lister, a.node_lookup,
+                                             a.hard_pod_affinity_weight,
+                                             a.failure_domains))
+register_priority("ImageLocalityPriority", 1,
+                  lambda a: prios.image_locality_priority)
+register_priority("EqualPriority", 1, lambda a: prios.equal_priority)
+
+
+def _noop_predicate(pod, node_info):
+    return None
+
+
+DEFAULT_PROVIDER = register_algorithm_provider(
+    "DefaultProvider",
+    # defaults.go:110-143
+    ["NoDiskConflict", "NoVolumeZoneConflict", "MaxEBSVolumeCount",
+     "MaxGCEPDVolumeCount", "GeneralPredicates", "PodToleratesNodeTaints",
+     "CheckNodeMemoryPressure", "MatchInterPodAffinity"],
+    ["LeastRequestedPriority", "BalancedResourceAllocation",
+     "SelectorSpreadPriority", "NodeAffinityPriority",
+     "TaintTolerationPriority", "InterPodAffinityPriority"],
+)
+
+
+# --- policy file (api/types.go:27-173) ---------------------------------------
+
+def load_policy(policy: dict, args: PluginArgs):
+    """Build (predicates, priorities, extender_configs) from a policy dict
+    (the --policy-config-file JSON). Custom predicate arguments are limited
+    to ServiceAffinity/LabelsPresence; custom priorities to
+    ServiceAntiAffinity/LabelPreference — exactly the reference's whitelist."""
+    predicates: Dict[str, Callable] = {}
+    for p in policy.get("predicates", []):
+        name, argspec = p["name"], p.get("argument")
+        if argspec and "serviceAffinity" in argspec:
+            predicates[name] = preds.ServiceAffinity(
+                args.pod_lister, args.service_lister, args.node_lookup,
+                argspec["serviceAffinity"]["labels"])
+        elif argspec and "labelsPresence" in argspec:
+            predicates[name] = preds.NodeLabelChecker(
+                argspec["labelsPresence"]["labels"],
+                argspec["labelsPresence"].get("presence", True))
+        else:
+            predicates.update(get_predicates([name], args))
+    priorities: List[PriorityConfig] = []
+    for p in policy.get("priorities", []):
+        name, weight = p["name"], p.get("weight", 1)
+        argspec = p.get("argument")
+        if argspec and "serviceAntiAffinity" in argspec:
+            priorities.append(PriorityConfig(
+                prios.ServiceAntiAffinity(args.pod_lister, args.service_lister,
+                                          argspec["serviceAntiAffinity"]["label"]),
+                weight=weight, name=name))
+        elif argspec and "labelPreference" in argspec:
+            priorities.append(PriorityConfig(
+                prios.NodeLabelPriority(
+                    argspec["labelPreference"]["label"],
+                    argspec["labelPreference"].get("presence", True)),
+                weight=weight, name=name))
+        else:
+            priorities.extend(get_priorities([name], args, weights={name: weight}))
+    return predicates, priorities, policy.get("extenders", [])
+
+
+def load_policy_file(path: str, args: PluginArgs):
+    with open(path) as f:
+        return load_policy(json.load(f), args)

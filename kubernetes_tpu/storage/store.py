@@ -184,15 +184,15 @@ class MemStore:
             return self._rv
 
     def guaranteed_update(self, key: str,
-                          fn: Callable[[dict], Optional[dict]],
+                          fn: Callable[[dict, int], Optional[dict]],
                           max_retries: int = 10) -> Tuple[dict, int]:
-        """CAS retry loop: fn(current) -> new object (or raise to abort).
-        fn returning None aborts without error (no-op). In-process the lock
-        makes one attempt sufficient, but the retry structure is kept because
-        fn may observe state via other stores/side effects."""
+        """CAS retry loop: fn(current, current_rv) -> new object (or raise to
+        abort). fn returning None aborts without error (no-op). In-process
+        the lock makes one attempt sufficient, but the retry structure is
+        kept because fn may observe state via other stores/side effects."""
         for _ in range(max_retries):
             obj, rv = self.get(key)
-            new = fn(obj)
+            new = fn(obj, rv)
             if new is None:
                 return obj, rv
             try:

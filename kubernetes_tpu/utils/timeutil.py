@@ -1,0 +1,8 @@
+"""Shared timestamp helpers (one format for server- and client-stamped
+metadata/events)."""
+
+import time
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())

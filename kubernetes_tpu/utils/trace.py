@@ -1,0 +1,45 @@
+"""Step tracing: named multi-step traces logged only when over threshold.
+
+Parity target: reference pkg/util/trace.go:32-67 — the scheduler wraps every
+Schedule() in a trace with steps "Computing predicates"/"Prioritizing"/
+"Selecting host" and logs it only if the decision exceeded 20ms
+(generic_scheduler.go:71-77).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import List, Tuple
+
+log = logging.getLogger("trace")
+
+
+class Trace:
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.perf_counter()
+        self.steps: List[Tuple[str, float]] = []
+
+    @contextmanager
+    def step(self, name: str):
+        try:
+            yield
+        finally:
+            self.steps.append((name, time.perf_counter()))
+
+    def total_seconds(self) -> float:
+        return time.perf_counter() - self.start
+
+    def log_if_slow(self, threshold_seconds: float):
+        total = self.total_seconds()
+        if total < threshold_seconds:
+            return
+        parts = [f'"{self.name}" {self.fields}: total {total * 1000:.1f}ms']
+        prev = self.start
+        for name, at in self.steps:
+            parts.append(f"  {name}: +{(at - prev) * 1000:.1f}ms")
+            prev = at
+        log.info("\n".join(parts))

@@ -1,0 +1,458 @@
+"""Scheduler unit tests: predicates (table-driven), priorities, cache state
+machine with injected time, generic scheduler — mirroring the reference's
+predicates_test.go / priorities_test.go / cache_test.go patterns."""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.cache import (
+    DEFAULT_MEMORY_REQUEST, DEFAULT_MILLI_CPU_REQUEST, NodeInfo, SchedulerCache,
+)
+from kubernetes_tpu.scheduler import predicates as preds
+from kubernetes_tpu.scheduler import priorities as prios
+from kubernetes_tpu.scheduler.generic import FitError, GenericScheduler, PriorityConfig
+
+
+def mk_pod(name="p", ns="default", cpu=None, mem=None, labels=None, node="",
+           host_ports=(), selector=None, affinity=None, tolerations=None,
+           volumes=None):
+    requests = {}
+    if cpu is not None:
+        requests["cpu"] = cpu
+    if mem is not None:
+        requests["memory"] = mem
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(
+            node_name=node,
+            containers=[api.Container(
+                name="c", image="img",
+                ports=[api.ContainerPort(host_port=p, container_port=p)
+                       for p in host_ports],
+                resources=api.ResourceRequirements(requests=requests) if requests else None)],
+            node_selector=selector, affinity=affinity, tolerations=tolerations,
+            volumes=volumes))
+
+
+def mk_node(name="n1", cpu="4", mem="32Gi", pods="110", labels=None,
+            taints=None, conditions=None, images=None):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels),
+        spec=api.NodeSpec(taints=taints),
+        status=api.NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": pods},
+            conditions=conditions or [api.NodeCondition(type="Ready", status="True")],
+            images=images))
+
+
+def ni(node, *pods):
+    info = NodeInfo(node)
+    for p in pods:
+        info.add_pod(p)
+    return info
+
+
+class TestPodFitsResources:
+    def test_fits(self):
+        preds.pod_fits_resources(mk_pod(cpu="1"), ni(mk_node(cpu="4")))
+
+    def test_insufficient_cpu(self):
+        info = ni(mk_node(cpu="4"), mk_pod("a", cpu="3", node="n1"))
+        with pytest.raises(preds.InsufficientResource) as ei:
+            preds.pod_fits_resources(mk_pod(cpu="2"), info)
+        assert ei.value.resource == "cpu"
+        assert (ei.value.requested, ei.value.used, ei.value.capacity) == (2000, 3000, 4000)
+
+    def test_insufficient_memory(self):
+        info = ni(mk_node(mem="1Gi"), mk_pod("a", mem="800Mi", node="n1"))
+        with pytest.raises(preds.InsufficientResource, match="memory"):
+            preds.pod_fits_resources(mk_pod(mem="300Mi"), info)
+
+    def test_pod_count_cap(self):
+        node = mk_node(pods="1")
+        info = ni(node, mk_pod("a", node="n1"))
+        with pytest.raises(preds.InsufficientResource, match="pods"):
+            preds.pod_fits_resources(mk_pod("b"), info)
+
+    def test_zero_request_always_fits_resources(self):
+        info = ni(mk_node(cpu="1"), mk_pod("a", cpu="1", node="n1"))
+        preds.pod_fits_resources(mk_pod("b"), info)  # no requests -> fits
+
+
+class TestHostAndPorts:
+    def test_pod_fits_host(self):
+        preds.pod_fits_host(mk_pod(node="n1"), ni(mk_node("n1")))
+        with pytest.raises(preds.PredicateFailure):
+            preds.pod_fits_host(mk_pod(node="other"), ni(mk_node("n1")))
+        preds.pod_fits_host(mk_pod(), ni(mk_node("n1")))  # unset: any node
+
+    def test_host_ports(self):
+        info = ni(mk_node(), mk_pod("a", host_ports=(8080,), node="n1"))
+        with pytest.raises(preds.PredicateFailure, match="8080"):
+            preds.pod_fits_host_ports(mk_pod(host_ports=(8080,)), info)
+        preds.pod_fits_host_ports(mk_pod(host_ports=(9090,)), info)
+
+
+class TestNodeSelectorAffinity:
+    def test_node_selector(self):
+        node = mk_node(labels={"disk": "ssd"})
+        preds.pod_matches_node_selector(mk_pod(selector={"disk": "ssd"}), ni(node))
+        with pytest.raises(preds.PredicateFailure):
+            preds.pod_matches_node_selector(mk_pod(selector={"disk": "hdd"}), ni(node))
+
+    @pytest.mark.parametrize("op,values,node_labels,fits", [
+        ("In", ["us-a", "us-b"], {"zone": "us-a"}, True),
+        ("In", ["us-a"], {"zone": "us-c"}, False),
+        ("NotIn", ["us-a"], {"zone": "us-c"}, True),
+        ("NotIn", ["us-a"], {"zone": "us-a"}, False),
+        ("Exists", None, {"zone": "x"}, True),
+        ("Exists", None, {}, False),
+        ("DoesNotExist", None, {}, True),
+        ("Gt", ["4"], {"zone": "8"}, True),
+        ("Gt", ["4"], {"zone": "2"}, False),
+        ("Lt", ["4"], {"zone": "2"}, True),
+    ])
+    def test_node_affinity_ops(self, op, values, node_labels, fits):
+        aff = api.Affinity(node_affinity=api.NodeAffinity(
+            required_during_scheduling_ignored_during_execution=api.NodeSelector(
+                node_selector_terms=[api.NodeSelectorTerm(match_expressions=[
+                    api.NodeSelectorRequirement(key="zone", operator=op,
+                                                values=values)])])))
+        pod = mk_pod(affinity=aff)
+        node = mk_node(labels=node_labels)
+        if fits:
+            preds.pod_matches_node_selector(pod, ni(node))
+        else:
+            with pytest.raises(preds.PredicateFailure):
+                preds.pod_matches_node_selector(pod, ni(node))
+
+    def test_terms_are_ored(self):
+        aff = api.Affinity(node_affinity=api.NodeAffinity(
+            required_during_scheduling_ignored_during_execution=api.NodeSelector(
+                node_selector_terms=[
+                    api.NodeSelectorTerm(match_expressions=[
+                        api.NodeSelectorRequirement(key="a", operator="In", values=["1"])]),
+                    api.NodeSelectorTerm(match_expressions=[
+                        api.NodeSelectorRequirement(key="b", operator="In", values=["2"])]),
+                ])))
+        preds.pod_matches_node_selector(mk_pod(affinity=aff),
+                                        ni(mk_node(labels={"b": "2"})))
+
+
+class TestTaints:
+    def test_untolerated_noschedule(self):
+        node = mk_node(taints=[api.Taint(key="dedicated", value="ml",
+                                         effect="NoSchedule")])
+        with pytest.raises(preds.PredicateFailure, match="dedicated"):
+            preds.pod_tolerates_node_taints(mk_pod(), ni(node))
+
+    def test_tolerated(self):
+        node = mk_node(taints=[api.Taint(key="dedicated", value="ml",
+                                         effect="NoSchedule")])
+        pod = mk_pod(tolerations=[api.Toleration(key="dedicated", operator="Equal",
+                                                 value="ml", effect="NoSchedule")])
+        preds.pod_tolerates_node_taints(pod, ni(node))
+
+    def test_prefer_no_schedule_ignored_by_predicate(self):
+        node = mk_node(taints=[api.Taint(key="x", value="y",
+                                         effect="PreferNoSchedule")])
+        preds.pod_tolerates_node_taints(mk_pod(), ni(node))
+
+
+class TestMemoryPressureAndDisk:
+    def test_besteffort_blocked_on_pressure(self):
+        node = mk_node(conditions=[
+            api.NodeCondition(type="Ready", status="True"),
+            api.NodeCondition(type="MemoryPressure", status="True")])
+        with pytest.raises(preds.PredicateFailure, match="memory pressure"):
+            preds.check_node_memory_pressure(mk_pod(), ni(node))
+        # burstable pod is allowed
+        preds.check_node_memory_pressure(mk_pod(cpu="1"), ni(node))
+
+    def test_gce_pd_conflict(self):
+        vol = api.Volume(name="d", gce_persistent_disk=api.GCEPersistentDiskVolumeSource(pd_name="pd1"))
+        info = ni(mk_node(), mk_pod("a", node="n1", volumes=[vol]))
+        with pytest.raises(preds.PredicateFailure, match="disk conflict"):
+            preds.no_disk_conflict(mk_pod(volumes=[vol]), info)
+        ro = api.Volume(name="d", gce_persistent_disk=api.GCEPersistentDiskVolumeSource(
+            pd_name="pd1", read_only=True))
+        info_ro = ni(mk_node(), mk_pod("a", node="n1", volumes=[ro]))
+        preds.no_disk_conflict(mk_pod(volumes=[ro]), info_ro)  # both RO: ok
+
+    def test_max_pd_volume_count(self):
+        checker = preds.MaxPDVolumeCountChecker("gce-pd", 2)
+        v = lambda pd: api.Volume(name=pd, gce_persistent_disk=api.GCEPersistentDiskVolumeSource(pd_name=pd))
+        info = ni(mk_node(), mk_pod("a", node="n1", volumes=[v("pd1"), v("pd2")]))
+        with pytest.raises(preds.PredicateFailure, match="max gce-pd"):
+            checker(mk_pod(volumes=[v("pd3")]), info)
+        checker(mk_pod(volumes=[v("pd1")]), info)  # already-attached: free
+
+
+class FakePodLister:
+    def __init__(self, pods):
+        self.pods = pods
+
+    def list(self, selector=None):
+        if selector is None:
+            return list(self.pods)
+        return [p for p in self.pods if selector.matches(p.metadata.labels or {})]
+
+
+class TestInterPodAffinity:
+    def _checker(self, pods, nodes):
+        node_map = {n.metadata.name: n for n in nodes}
+        return preds.InterPodAffinity(FakePodLister(pods), node_map.get)
+
+    def _aff_term(self, key, value, topo=api.LABEL_HOSTNAME):
+        return api.PodAffinityTerm(
+            label_selector=api.LabelSelector(match_labels={key: value}),
+            topology_key=topo)
+
+    def test_hard_affinity_satisfied(self):
+        n1 = mk_node("n1", labels={api.LABEL_HOSTNAME: "n1"})
+        existing = mk_pod("db", labels={"app": "db"}, node="n1")
+        pod = mk_pod("web", affinity=api.Affinity(pod_affinity=api.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                self._aff_term("app", "db")])))
+        self._checker([existing], [n1])(pod, ni(n1, existing))
+
+    def test_hard_affinity_unsatisfied(self):
+        n1 = mk_node("n1", labels={api.LABEL_HOSTNAME: "n1"})
+        n2 = mk_node("n2", labels={api.LABEL_HOSTNAME: "n2"})
+        existing = mk_pod("db", labels={"app": "db"}, node="n2")
+        pod = mk_pod("web", affinity=api.Affinity(pod_affinity=api.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                self._aff_term("app", "db")])))
+        with pytest.raises(preds.PredicateFailure):
+            self._checker([existing], [n1, n2])(pod, ni(n1))
+
+    def test_disregard_rule_first_pod_of_group(self):
+        """Self-selecting affinity with no matches anywhere may schedule
+        (predicates.go:818-844)."""
+        n1 = mk_node("n1", labels={api.LABEL_HOSTNAME: "n1"})
+        pod = mk_pod("web", labels={"app": "web"},
+                     affinity=api.Affinity(pod_affinity=api.PodAffinity(
+                         required_during_scheduling_ignored_during_execution=[
+                             self._aff_term("app", "web")])))
+        self._checker([], [n1])(pod, ni(n1))
+
+    def test_disregard_not_applied_when_peer_exists_elsewhere(self):
+        n1 = mk_node("n1", labels={api.LABEL_HOSTNAME: "n1"})
+        n2 = mk_node("n2", labels={api.LABEL_HOSTNAME: "n2"})
+        peer = mk_pod("web2", labels={"app": "web"}, node="n2")
+        pod = mk_pod("web", labels={"app": "web"},
+                     affinity=api.Affinity(pod_affinity=api.PodAffinity(
+                         required_during_scheduling_ignored_during_execution=[
+                             self._aff_term("app", "web")])))
+        with pytest.raises(preds.PredicateFailure):
+            self._checker([peer], [n1, n2])(pod, ni(n1))
+
+    def test_anti_affinity(self):
+        n1 = mk_node("n1", labels={api.LABEL_HOSTNAME: "n1"})
+        existing = mk_pod("web1", labels={"app": "web"}, node="n1")
+        pod = mk_pod("web2", affinity=api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                self._aff_term("app", "web")])))
+        with pytest.raises(preds.PredicateFailure, match="anti-affinity"):
+            self._checker([existing], [n1])(pod, ni(n1, existing))
+
+    def test_symmetry_existing_anti_affinity(self):
+        """An existing pod's anti-affinity keeps matching pods away
+        (predicates.go:883-921)."""
+        n1 = mk_node("n1", labels={api.LABEL_HOSTNAME: "n1"})
+        lonely = mk_pod("lonely", labels={"app": "lonely"}, node="n1",
+                        affinity=api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                            required_during_scheduling_ignored_during_execution=[
+                                self._aff_term("app", "web")])))
+        pod = mk_pod("web", labels={"app": "web"})
+        with pytest.raises(preds.PredicateFailure, match="existing pod"):
+            self._checker([lonely], [n1])(pod, ni(n1, lonely))
+
+    def test_zone_topology(self):
+        za = {api.LABEL_ZONE: "us-a"}
+        n1 = mk_node("n1", labels=za)
+        n2 = mk_node("n2", labels=za)
+        existing = mk_pod("db", labels={"app": "db"}, node="n2")
+        pod = mk_pod("web", affinity=api.Affinity(pod_affinity=api.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                self._aff_term("app", "db", topo=api.LABEL_ZONE)])))
+        # same zone, different node: satisfied
+        self._checker([existing], [n1, n2])(pod, ni(n1))
+
+
+class TestPriorities:
+    def test_least_requested_math(self):
+        """cpu (4000-2000)*10/4000=5, mem (32Gi-16Gi)*10/32Gi=5 -> 5."""
+        node = mk_node("n1", cpu="4", mem="32Gi")
+        info = {"n1": ni(node, mk_pod("a", cpu="2", mem="16Gi", node="n1"))}
+        scores = prios.least_requested(mk_pod("x"), info, [node])
+        # incoming pod adds nonzero defaults (100m, 200Mi)
+        cpu_score = ((4000 - 2100) * 10) // 4000  # 4
+        mem_score = ((32 * 2**30 - (16 * 2**30 + DEFAULT_MEMORY_REQUEST)) * 10) // (32 * 2**30)
+        assert scores["n1"] == (cpu_score + mem_score) // 2
+
+    def test_least_requested_empty_node_wins(self):
+        n1, n2 = mk_node("n1"), mk_node("n2")
+        info = {"n1": ni(n1, mk_pod("a", cpu="3", mem="20Gi", node="n1")),
+                "n2": ni(n2)}
+        scores = prios.least_requested(mk_pod("x", cpu="100m"), info, [n1, n2])
+        assert scores["n2"] > scores["n1"]
+
+    def test_balanced_resource(self):
+        node = mk_node("n1", cpu="4", mem="32Gi")
+        # perfectly balanced: cpu 50%, mem 50%
+        info = {"n1": ni(node, mk_pod("a", cpu="1900m", mem=f"{16 * 2**30 - DEFAULT_MEMORY_REQUEST}", node="n1"))}
+        scores = prios.balanced_resource_allocation(mk_pod("x", cpu="100m"), info, [node])
+        assert scores["n1"] == 10
+
+    def test_balanced_overcommit_zero(self):
+        node = mk_node("n1", cpu="1", mem="1Gi")
+        info = {"n1": ni(node, mk_pod("a", cpu="2", node="n1"))}
+        assert prios.balanced_resource_allocation(mk_pod("x"), info, [node])["n1"] == 0
+
+    def test_selector_spread(self):
+        class FakeSvcLister:
+            def get_pod_services(self, pod):
+                return [api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                                    spec=api.ServiceSpec(selector={"app": "web"}))]
+
+        class EmptyLister:
+            def get_pod_controllers(self, pod):
+                return []
+
+            def get_pod_replica_sets(self, pod):
+                return []
+
+        spread = prios.SelectorSpread(FakeSvcLister(), EmptyLister(), EmptyLister())
+        n1, n2 = mk_node("n1"), mk_node("n2")
+        info = {"n1": ni(n1, mk_pod("w1", labels={"app": "web"}, node="n1"),
+                         mk_pod("w2", labels={"app": "web"}, node="n1")),
+                "n2": ni(n2, mk_pod("w3", labels={"app": "web"}, node="n2"))}
+        scores = spread(mk_pod("w4", labels={"app": "web"}), info, [n1, n2])
+        assert scores["n1"] == 0          # max count -> 0
+        assert scores["n2"] == 5          # 10*(2-1)/2
+
+    def test_node_affinity_preferred(self):
+        aff = api.Affinity(node_affinity=api.NodeAffinity(
+            preferred_during_scheduling_ignored_during_execution=[
+                api.PreferredSchedulingTerm(weight=80, preference=api.NodeSelectorTerm(
+                    match_expressions=[api.NodeSelectorRequirement(
+                        key="zone", operator="In", values=["us-a"])])),
+                api.PreferredSchedulingTerm(weight=20, preference=api.NodeSelectorTerm(
+                    match_expressions=[api.NodeSelectorRequirement(
+                        key="disk", operator="In", values=["ssd"])]))]))
+        n1 = mk_node("n1", labels={"zone": "us-a", "disk": "ssd"})
+        n2 = mk_node("n2", labels={"zone": "us-a"})
+        n3 = mk_node("n3", labels={})
+        scores = prios.node_affinity_priority(mk_pod(affinity=aff), {}, [n1, n2, n3])
+        assert scores == {"n1": 10, "n2": 8, "n3": 0}
+
+    def test_taint_toleration_priority(self):
+        t = api.Taint(key="k", value="v", effect="PreferNoSchedule")
+        n1 = mk_node("n1", taints=[t, api.Taint(key="k2", value="v", effect="PreferNoSchedule")])
+        n2 = mk_node("n2", taints=[t])
+        n3 = mk_node("n3")
+        scores = prios.taint_toleration_priority(mk_pod(), {}, [n1, n2, n3])
+        assert scores == {"n1": 0, "n2": 5, "n3": 10}
+
+    def test_image_locality(self):
+        img = api.ContainerImage(names=["img"], size_bytes=500 * 1024 * 1024)
+        n1 = mk_node("n1", images=[img])
+        n2 = mk_node("n2")
+        pod = mk_pod()
+        scores = prios.image_locality_priority(pod, {}, [n1, n2])
+        assert scores["n2"] == 0 and 0 < scores["n1"] <= 10
+
+    def test_equal_priority(self):
+        assert prios.equal_priority(mk_pod(), {}, [mk_node("a"), mk_node("b")]) == {
+            "a": 1, "b": 1}
+
+
+class TestSchedulerCache:
+    def test_assume_confirm_lifecycle(self):
+        now = [100.0]
+        cache = SchedulerCache(ttl=30, clock=lambda: now[0])
+        cache.add_node(mk_node("n1"))
+        pod = mk_pod("p", cpu="1", node="n1")
+        cache.assume_pod(pod, now=now[0])
+        assert cache.is_assumed(pod)
+        info = cache.get_node_name_to_info_map()
+        assert info["n1"].requested.milli_cpu == 1000
+        # informer confirms
+        cache.add_pod(pod)
+        assert not cache.is_assumed(pod)
+        assert cache.get_node_name_to_info_map()["n1"].requested.milli_cpu == 1000
+        # expiry after confirm must not remove anything
+        now[0] += 100
+        assert cache.cleanup_expired() == []
+        assert cache.get_node_name_to_info_map()["n1"].requested.milli_cpu == 1000
+
+    def test_assume_expiry_rolls_back(self):
+        now = [0.0]
+        cache = SchedulerCache(ttl=30, clock=lambda: now[0])
+        cache.add_node(mk_node("n1"))
+        cache.assume_pod(mk_pod("p", cpu="1", node="n1"), now=0.0)
+        now[0] = 31.0
+        assert cache.cleanup_expired() == ["default/p"]
+        assert cache.get_node_name_to_info_map()["n1"].requested.milli_cpu == 0
+
+    def test_remove_pod(self):
+        cache = SchedulerCache()
+        cache.add_node(mk_node("n1"))
+        pod = mk_pod("p", cpu="1", node="n1")
+        cache.add_pod(pod)
+        cache.remove_pod(pod)
+        assert cache.get_node_name_to_info_map()["n1"].requested.milli_cpu == 0
+
+    def test_snapshot_isolation(self):
+        cache = SchedulerCache()
+        cache.add_node(mk_node("n1"))
+        snap = cache.get_node_name_to_info_map()
+        cache.add_pod(mk_pod("p", cpu="1", node="n1"))
+        assert snap["n1"].requested.milli_cpu == 0  # clone, not view
+
+
+class TestGenericScheduler:
+    def _mk(self, predicates=None, priorities=None):
+        return GenericScheduler(
+            predicates or {"PodFitsResources": preds.pod_fits_resources},
+            priorities or [PriorityConfig(prios.least_requested)],
+            parallel=False)
+
+    def test_picks_least_loaded(self):
+        n1, n2 = mk_node("n1"), mk_node("n2")
+        info = {"n1": ni(n1, mk_pod("a", cpu="3", mem="20Gi", node="n1")),
+                "n2": ni(n2)}
+        assert self._mk().schedule(mk_pod("x", cpu="1"), info, [n1, n2]) == "n2"
+
+    def test_fit_error_reasons(self):
+        n1 = mk_node("n1", cpu="1")
+        info = {"n1": ni(n1, mk_pod("a", cpu="1", node="n1"))}
+        with pytest.raises(FitError) as ei:
+            self._mk().schedule(mk_pod("x", cpu="1"), info, [n1])
+        assert "Insufficient cpu" in ei.value.failed_predicates["n1"]
+
+    def test_round_robin_tie_break(self):
+        sched = self._mk(priorities=[PriorityConfig(prios.equal_priority)])
+        nodes = [mk_node("a"), mk_node("b"), mk_node("c")]
+        info = {n.metadata.name: ni(n) for n in nodes}
+        picks = [sched.schedule(mk_pod(f"p{i}"), info, nodes) for i in range(6)]
+        assert picks == ["a", "b", "c", "a", "b", "c"]
+
+    def test_no_nodes(self):
+        with pytest.raises(FitError, match="no nodes"):
+            self._mk().schedule(mk_pod("x"), {}, [])
+
+    def test_weighted_sum(self):
+        def prio_a(pod, info, nodes):
+            return {"n1": 1, "n2": 2}
+
+        def prio_b(pod, info, nodes):
+            return {"n1": 10, "n2": 0}
+
+        sched = GenericScheduler(
+            {}, [PriorityConfig(prio_a, weight=5), PriorityConfig(prio_b, weight=1)],
+            parallel=False)
+        nodes = [mk_node("n1"), mk_node("n2")]
+        scores = sched.prioritize_nodes(mk_pod(), {}, nodes)
+        assert scores == {"n1": 15, "n2": 10}

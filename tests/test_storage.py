@@ -58,10 +58,10 @@ def test_cas_update():
 def test_guaranteed_update():
     s = MemStore()
     s.create("/k", {"v": 0})
-    obj, rv = s.guaranteed_update("/k", lambda o: {**o, "v": o["v"] + 1})
+    obj, rv = s.guaranteed_update("/k", lambda o, _rv: {**o, "v": o["v"] + 1})
     assert obj["v"] == 1
     # fn returning None = no-op
-    obj2, rv2 = s.guaranteed_update("/k", lambda o: None)
+    obj2, rv2 = s.guaranteed_update("/k", lambda o, _rv: None)
     assert obj2["v"] == 1 and rv2 == rv
 
 
@@ -72,7 +72,7 @@ def test_guaranteed_update_concurrent():
 
     def work():
         for _ in range(n_incr):
-            s.guaranteed_update("/counter", lambda o: {**o, "v": o["v"] + 1})
+            s.guaranteed_update("/counter", lambda o, _rv: {**o, "v": o["v"] + 1})
 
     ts = [threading.Thread(target=work) for _ in range(n_threads)]
     [t.start() for t in ts]
