@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""Benchmark: the north-star config — 30k pending pods onto 5k nodes.
+
+Mirrors the reference's scheduler_perf harness shapes
+(test/component/scheduler/perf/util.go:85-131: nodes 4 CPU / 32Gi / 110-pod
+cap; pause pods requesting 100m / 500Mi) scaled to BASELINE.json config #5
+(30k pods / 5k nodes), with zones, a service for spread scoring, taints and
+node labels so the full default-provider predicate/priority surface is
+exercised.
+
+Prints ONE JSON line:
+  metric       pods scheduled per second through the TPU kernel (steady-state
+               device wall-clock, excluding host tensorize + compile)
+  vs_baseline  value / 30000 — fraction of the "30k pods in <1s" north star
+               (1.0 = north star met; the reference Go scheduler achieves
+               ~0.001-0.002 on this workload)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_NODES = int(os.environ.get("BENCH_NODES", 5000))
+N_PODS = int(os.environ.get("BENCH_PODS", 30000))
+
+
+def build_cluster():
+    from kubernetes_tpu.api import types as api
+
+    zones = [f"us-z{i}" for i in range(8)]
+    nodes = []
+    for i in range(N_NODES):
+        labels = {api.LABEL_HOSTNAME: f"node-{i:05d}",
+                  api.LABEL_ZONE: zones[i % len(zones)]}
+        if i % 10 == 0:
+            labels["disk"] = "ssd"
+        taints = None
+        if i % 50 == 0:
+            taints = [api.Taint(key="dedicated", value="infra",
+                                effect="NoSchedule")]
+        nodes.append(api.Node(
+            metadata=api.ObjectMeta(name=f"node-{i:05d}", labels=labels),
+            spec=api.NodeSpec(taints=taints),
+            status=api.NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[api.NodeCondition(type="Ready", status="True")])))
+
+    svc = api.Service(
+        metadata=api.ObjectMeta(name="web", namespace="default"),
+        spec=api.ServiceSpec(selector={"app": "web"},
+                             ports=[api.ServicePort(port=80)]))
+
+    pending = []
+    for i in range(N_PODS):
+        labels = {"app": "web" if i % 3 == 0 else f"batch-{i % 7}"}
+        kw = {}
+        if i % 20 == 0:
+            kw["node_selector"] = {"disk": "ssd"}
+        if i % 50 == 7:
+            kw["tolerations"] = [api.Toleration(key="dedicated",
+                                                operator="Exists")]
+        pending.append(api.Pod(
+            metadata=api.ObjectMeta(name=f"pod-{i:05d}", namespace="default",
+                                    labels=labels),
+            spec=api.PodSpec(
+                containers=[api.Container(
+                    name="c", image="pause",
+                    resources=api.ResourceRequirements(
+                        requests={"cpu": "100m", "memory": "500Mi"}))],
+                **kw)))
+    return nodes, pending, [svc]
+
+
+def main():
+    t_start = time.perf_counter()
+    import jax
+
+    from kubernetes_tpu.ops.kernel import Weights, _schedule_jit
+    from kubernetes_tpu.ops.tensorize import Tensorizer
+    from kubernetes_tpu.scheduler.batch import ListServiceLister, make_plugin_args
+
+    nodes, pending, services = build_cluster()
+    t_built = time.perf_counter()
+
+    args = make_plugin_args(nodes, service_lister=ListServiceLister(services))
+    ct = Tensorizer(plugin_args=args).build(nodes, [], pending)
+    t_tensorized = time.perf_counter()
+
+    import jax.numpy as jnp
+    arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
+    jax.block_until_ready(arrays)
+    t_upload = time.perf_counter()
+
+    weights = Weights()
+    out = _schedule_jit(arrays, ct.n_zones, weights)
+    jax.block_until_ready(out)
+    t_compiled = time.perf_counter()
+
+    # steady state: same compiled program, fresh run
+    runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = _schedule_jit(arrays, ct.n_zones, weights)
+        jax.block_until_ready(out)
+        runs.append(time.perf_counter() - t0)
+    best = min(runs)
+
+    import numpy as np
+    res = np.asarray(out)[: ct.n_real_pods]
+    scheduled = int((res >= 0).sum())
+
+    # correctness guard: no node overcommitted on cpu or pod slots
+    assign = res[res >= 0]
+    counts = np.bincount(assign, minlength=ct.n_real_nodes)
+    assert counts.max() <= 110, f"pod-count overcommit: {counts.max()}"
+    cpu_used = counts * 100  # every pod requests 100m
+    assert cpu_used.max() <= 4000, f"cpu overcommit: {cpu_used.max()}"
+
+    pods_per_sec = scheduled / best if best > 0 else 0.0
+    result = {
+        "metric": f"pods_scheduled_per_sec @ {N_PODS // 1000}k pods / {N_NODES // 1000}k nodes (full default-provider kernel)",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_sec / 30000.0, 3),
+        "detail": {
+            "device": str(jax.devices()[0]),
+            "scheduled": scheduled,
+            "total_pods": ct.n_real_pods,
+            "kernel_seconds": round(best, 4),
+            "compile_seconds": round(t_compiled - t_upload, 1),
+            "tensorize_seconds": round(t_tensorized - t_built, 1),
+            "runs": [round(r, 4) for r in runs],
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

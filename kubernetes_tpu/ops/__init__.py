@@ -1,0 +1,20 @@
+"""TPU kernels: the scheduler's filter-and-score pipeline as tensor ops.
+
+This is the re-expression of reference plugin/pkg/scheduler's hot loop
+(SURVEY §2.9, §7) as a batched constraint-satisfaction kernel:
+
+  tensorize.py  host-side compilation of cluster state + a pending-pod batch
+                into dense, vocabulary-encoded tensors (the tensorization of
+                schedulercache.NodeInfo, node_info.go:32-49)
+  kernel.py     the two-stage device program:
+                  stage A (batched, MXU): assignment-independent predicate
+                  masks and score matrices over pods x nodes — label/affinity/
+                  taint/port/image terms as [P,L] @ [L,N] matmuls
+                  stage B (lax.scan): sequential greedy commit replicating the
+                  one-pod-at-a-time assume semantics (AssumePod, cache.go:101)
+                  with capacity/ports/spread updated in-carry, round-robin
+                  tie-break matching selectHost (generic_scheduler.go:116-133)
+
+The kernel's bindings must equal the Python oracle's, pod for pod — enforced
+by the differential tests (tests/test_tpu_kernel.py).
+"""

@@ -1,0 +1,250 @@
+"""The filter-and-score kernel: batched masks/scores + sequential commit.
+
+Two stages, mirroring the decomposition in SURVEY §7:
+
+Stage A (assignment-independent, MXU-batched):
+  - predicate masks: node selector / NodeAffinity / taints / memory-pressure /
+    host pinning / inter-pod static — each one matmul + compare over the
+    vocab-encoded tensors (predicates.go:416-1002 vectorized)
+  - score ingredients that don't depend on commits: preferred-affinity weight
+    counts, intolerable-PreferNoSchedule counts, image-locality buckets
+
+Stage B (lax.scan over pods in FIFO order):
+  replicates the reference's one-pod-at-a-time semantics exactly — each step
+  sees capacity/ports/spread state that includes every prior in-batch commit
+  (the on-device analogue of AssumePod, cache.go:101). Priorities normalize
+  over the *feasible* node set per pod (the reference prioritizes only
+  filtered nodes, generic_scheduler.go:94-107), so normalizations are
+  computed in-step against the dynamic mask. Ties break round-robin over the
+  canonical node order with a carried counter (selectHost,
+  generic_scheduler.go:116-133).
+
+Integer-truncation points match the Go code: calculateScore's
+((cap-req)*10)/cap, the (cpu+mem)/2 average, int(fScore) everywhere
+(priorities.go:33-43 etc.) — implemented as floor on non-negative f32.
+
+All shapes are static per batch (padded); the jit cache is keyed by padded
+(P, N, vocab) sizes, so repeated batches of similar shape reuse the compile.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.ops.tensorize import ClusterTensors
+
+NEG = jnp.float32(-1e9)
+
+
+@dataclass(frozen=True)
+class Weights:
+    """Priority weights (DefaultProvider: all 1, image/equal off —
+    defaults.go:150-197)."""
+
+    least_requested: int = 1
+    balanced: int = 1
+    spread: int = 1
+    node_affinity: int = 1
+    taint_toleration: int = 1
+    image_locality: int = 0
+    equal: int = 0
+
+
+# --- stage A -----------------------------------------------------------------
+
+def static_pass(t: dict) -> dict:
+    """All [P, N] mask/score ingredients that don't depend on assignment."""
+    node_labels = t["node_labels"]          # [N, L]
+    P = t["req"].shape[0]
+    N = t["alloc"].shape[0]
+
+    sel_ok = (t["sel_required"] @ node_labels.T) >= t["sel_count"][:, None]
+
+    term_node = (t["term_expr"] @ t["expr_node"]) >= t["term_expr_count"][:, None]
+    aff_hits = t["pod_term"] @ term_node.astype(jnp.float32)
+    aff_ok = (~t["pod_has_affinity"][:, None]) | (aff_hits >= 1.0)
+
+    untol = (1.0 - t["tol_nosched"]) @ t["taints_nosched"].T
+    taint_ok = untol == 0.0
+
+    mem_ok = ~(t["best_effort"][:, None] & t["mem_pressure"][None, :])
+
+    idx = jnp.arange(N, dtype=jnp.int32)
+    host = t["host_req"][:, None]
+    host_ok = (host == -1) | (host == idx[None, :])
+
+    static_mask = (
+        t["node_valid"][None, :] & sel_ok & aff_ok & taint_ok & mem_ok & host_ok
+        & (t["interpod_forbidden"] == 0.0) & (t["interpod_required_miss"] == 0.0))
+
+    pref_count = (t["pod_pref_term"] * t["pref_weight"][None, :]) @ t["pref_term_node"]
+    taint_pref_count = (1.0 - t["tol_prefer"]) @ t["taints_prefer"].T
+
+    image_mib = t["pod_images"] @ t["image_node_sizes"].T
+    min_mib, max_mib = 23.0, 1000.0
+    image_score = jnp.where(
+        image_mib < min_mib, 0.0,
+        jnp.where(image_mib >= max_mib, 10.0,
+                  jnp.floor(10.0 * (image_mib - min_mib) / (max_mib - min_mib)) + 1.0))
+
+    return {"mask": static_mask, "pref_count": pref_count,
+            "taint_pref_count": taint_pref_count, "image_score": image_score}
+
+
+# --- stage B -----------------------------------------------------------------
+
+def _masked_max(x, mask):
+    return jnp.max(jnp.where(mask, x, NEG))
+
+
+def greedy_commit(t: dict, s: dict, w: Weights):
+    """lax.scan over pods; returns assignments [P] i32 (-1 = unschedulable)."""
+    alloc = t["alloc"]                      # [N, 4]
+    N = alloc.shape[0]
+    zone_id = t["zone_id"]                  # [N]
+    Z = int(t["n_zones"]) if isinstance(t["n_zones"], int) else t["n_zones"]
+    G = t["group_counts0"].shape[1]
+    idx_n = jnp.arange(N, dtype=jnp.int32)
+
+    zero_req = jnp.all(t["req"][:, :3] == 0.0, axis=1)  # pods axis excluded
+
+    # zone membership one-hot; zone counts are recomputed per step over the
+    # *feasible* node set (the reference sums countsByZone over filtered
+    # nodes only, selector_spreading.go:186-196)
+    zone_onehot = ((zone_id[:, None] == jnp.arange(Z)[None, :])
+                   & (zone_id >= 0)[:, None]).astype(jnp.float32)  # [N, Z]
+
+    xs = {
+        "req": t["req"], "nz": t["nonzero_req"], "ports": t["pod_ports"],
+        "mask": s["mask"], "pref": s["pref_count"],
+        "taint_pref": s["taint_pref_count"], "image": s["image_score"],
+        "group": t["pod_group"], "in_group": t["pod_in_group"],
+        "valid": t["pod_valid"], "zero_req": zero_req,
+    }
+
+    init = {
+        "used": t["used0"], "used_nz": t["used0_nonzero"],
+        "ports": t["node_ports0"], "gcounts": t["group_counts0"],
+        "rr": jnp.int32(0),
+    }
+
+    wf = {k: jnp.float32(v) for k, v in w.__dict__.items()}
+
+    def step(carry, x):
+        used, used_nz, ports, gcounts, rr = (
+            carry["used"], carry["used_nz"], carry["ports"],
+            carry["gcounts"], carry["rr"])
+
+        # --- dynamic predicates (PodFitsResources + ports) -------------------
+        pod_count_ok = used[:, 3] + 1.0 <= alloc[:, 3]
+        res_fit = jnp.all(used[:, :3] + x["req"][None, :3] <= alloc[:, :3], axis=1)
+        res_ok = x["zero_req"] | res_fit        # zero-request: count-only
+        port_clash = (ports @ x["ports"]) > 0.0
+        mask = x["mask"] & pod_count_ok & res_ok & (~port_clash)
+        feasible = jnp.any(mask) & x["valid"]
+
+        # --- dynamic scores --------------------------------------------------
+        cap_c, cap_m = alloc[:, 0], alloc[:, 1]
+        tot_c = used_nz[:, 0] + x["nz"][0]
+        tot_m = used_nz[:, 1] + x["nz"][1]
+        cpu_sc = jnp.where((cap_c > 0) & (tot_c <= cap_c),
+                           jnp.floor((cap_c - tot_c) * 10.0 / cap_c), 0.0)
+        mem_sc = jnp.where((cap_m > 0) & (tot_m <= cap_m),
+                           jnp.floor((cap_m - tot_m) * 10.0 / cap_m), 0.0)
+        least = jnp.floor((cpu_sc + mem_sc) / 2.0)
+
+        frac_c = jnp.where(cap_c > 0, tot_c / cap_c, 1.0)
+        frac_m = jnp.where(cap_m > 0, tot_m / cap_m, 1.0)
+        balanced = jnp.where((frac_c >= 1.0) | (frac_m >= 1.0), 0.0,
+                             jnp.floor(10.0 - jnp.abs(frac_c - frac_m) * 10.0))
+
+        # spread (maxes over the *feasible* node set, like the reference's
+        # filtered-node prioritization)
+        g = x["group"]
+        has_group = g >= 0
+        counts = jnp.where(has_group, gcounts[:, jnp.maximum(g, 0)], 0.0)
+        maxc = jnp.maximum(_masked_max(counts, mask), 0.0)
+        fscore = jnp.where(maxc > 0.0, 10.0 * (maxc - counts) / maxc, 10.0)
+        # zone sums over feasible nodes only (filtered-node semantics)
+        zsum = (jnp.where(mask, counts, 0.0) @ zone_onehot)          # [Z]
+        node_zc = zsum[jnp.maximum(zone_id, 0)]
+        maxz = jnp.maximum(_masked_max(jnp.where(zone_id >= 0, node_zc, NEG), mask), 0.0)
+        zscore = jnp.where(maxz > 0.0, 10.0 * (maxz - node_zc) / maxz, 10.0)
+        have_zones = jnp.any(mask & (zone_id >= 0))  # zones among feasible nodes
+        blend = jnp.where((zone_id >= 0) & has_group & have_zones & (maxz > 0.0),
+                          fscore * (1.0 / 3.0) + (2.0 / 3.0) * zscore, fscore)
+        spread = jnp.floor(jnp.where(has_group, blend, 10.0))
+
+        # node-affinity preferred (normalized over feasible set)
+        max_pref = _masked_max(x["pref"], mask)
+        node_aff = jnp.where(max_pref > 0.0,
+                             jnp.floor(10.0 * x["pref"] / max_pref), 0.0)
+
+        # taint PreferNoSchedule (normalized over feasible set)
+        max_tp = _masked_max(x["taint_pref"], mask)
+        taint_sc = jnp.where(max_tp > 0.0,
+                             jnp.floor((1.0 - x["taint_pref"] / max_tp) * 10.0), 10.0)
+
+        score = (wf["least_requested"] * least + wf["balanced"] * balanced
+                 + wf["spread"] * spread + wf["node_affinity"] * node_aff
+                 + wf["taint_toleration"] * taint_sc
+                 + wf["image_locality"] * x["image"] + wf["equal"] * 1.0)
+
+        # --- selectHost: max + round-robin tie-break -------------------------
+        masked_score = jnp.where(mask, score, NEG)
+        max_score = jnp.max(masked_score)
+        is_max = mask & (masked_score == max_score)
+        n_ties = jnp.sum(is_max.astype(jnp.int32))
+        k = jnp.where(n_ties > 0, rr % jnp.maximum(n_ties, 1), 0)
+        cum = jnp.cumsum(is_max.astype(jnp.int32))
+        chosen = jnp.argmax(is_max & (cum == k + 1))
+        chosen = jnp.where(feasible, chosen.astype(jnp.int32), jnp.int32(-1))
+
+        # --- commit (the on-device AssumePod) --------------------------------
+        commit = feasible
+        onehot = ((idx_n == chosen) & commit).astype(jnp.float32)
+        used = used + onehot[:, None] * x["req"][None, :]
+        used_nz = used_nz + onehot[:, None] * x["nz"][None, :]
+        ports = jnp.maximum(ports, onehot[:, None] * x["ports"][None, :])
+        gcounts = gcounts + onehot[:, None] * x["in_group"][None, :]
+        rr = rr + commit.astype(jnp.int32)
+
+        return ({"used": used, "used_nz": used_nz, "ports": ports,
+                 "gcounts": gcounts, "rr": rr}, chosen)
+
+    # unroll amortizes per-iteration loop overhead; the body is tiny
+    # (elementwise over N + one [N, PT] matvec) so overhead dominates
+    _, assignments = jax.lax.scan(step, init, xs, unroll=8)
+    return assignments
+
+
+# --- public API ---------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_zones", "weights"))
+def _schedule_jit(tensors: dict, n_zones: int, weights: Weights):
+    t = dict(tensors)
+    t["n_zones"] = n_zones
+    s = static_pass(t)
+    return greedy_commit(t, s, weights)
+
+
+def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
+                   device=None) -> List[Optional[str]]:
+    """Schedule a tensorized batch; returns node name (or None) per pending
+    pod, FIFO order."""
+    weights = weights or Weights()
+    arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
+    if device is not None:
+        arrays = jax.device_put(arrays, device)
+    out = np.asarray(_schedule_jit(arrays, ct.n_zones, weights))
+    result: List[Optional[str]] = []
+    for i in range(ct.n_real_pods):
+        n = int(out[i])
+        result.append(ct.node_names[n] if 0 <= n < ct.n_real_nodes else None)
+    return result

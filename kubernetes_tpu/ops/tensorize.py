@@ -1,0 +1,591 @@
+"""Host-side tensorization: cluster state -> dense, vocab-encoded arrays.
+
+Everything string-shaped (labels, taints, ports, images, selectors, affinity
+expressions, topology domains) is dictionary-encoded per batch into small
+integer vocabularies, so device code is pure arithmetic:
+
+- labels:  distinct (key, value) pairs over nodes -> columns of a bool
+  [N, L] matrix; a nodeSelector becomes a required-column indicator and
+  "all required present" is one [P, L] @ [L, N] matmul compared against the
+  per-pod requirement count. NodeAffinity expressions (In/NotIn/Exists/
+  DoesNotExist/Gt/Lt) compile to indicator rows over the same vocabulary
+  (Gt/Lt rows are host-precomputed per node), terms are AND-reductions,
+  term-sets OR-reductions — all matmuls (SURVEY §7 kernel formulation).
+- taints:  distinct (key, value, effect) triples; toleration sets become
+  tolerated-column indicators; "any untolerated NoSchedule taint" is again a
+  matmul against the complement.
+- ports:   distinct (protocol, hostPort) pairs; conflicts are an AND-matmul.
+  Port occupancy is part of the scan carry (it changes as pods commit).
+- spread:  pods sharing a selector signature (service/RC/RS sets,
+  selector_spreading.go:84) form a group; per-node and per-zone group counts
+  ride in the scan carry.
+- images:  distinct image names; ImageLocality's per-node present-size is
+  [P, I] @ (node_images * sizes) (priorities.go:137-207).
+- topology: per failure-domain key, nodes map to globally-offset domain ids;
+  inter-pod affinity terms become (term, domain) hit tables, precomputed
+  against existing pods and updated in-carry for in-batch commits.
+
+All vocab axes are padded to multiples of 128 (TPU lane width) and pod/node
+axes to multiples of 8 (sublane), so XLA tiles every matmul onto the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.cache import (
+    DEFAULT_MEMORY_REQUEST, DEFAULT_MILLI_CPU_REQUEST, NodeInfo,
+)
+
+MB = 1024 * 1024
+
+
+def _pad(n: int, mult: int) -> int:
+    return max(mult, ((n + mult - 1) // mult) * mult)
+
+
+class Vocab:
+    """Stable insertion-ordered dictionary encoder."""
+
+    def __init__(self):
+        self._ids: Dict = {}
+
+    def id(self, item) -> int:
+        i = self._ids.get(item)
+        if i is None:
+            i = len(self._ids)
+            self._ids[item] = i
+        return i
+
+    def get(self, item) -> Optional[int]:
+        return self._ids.get(item)
+
+    def __len__(self):
+        return len(self._ids)
+
+    def items(self):
+        return self._ids.items()
+
+
+@dataclass
+class ClusterTensors:
+    """Device-ready batch: N nodes x P pending pods (+ M existing pods folded
+    into initial aggregates). All arrays are numpy; the kernel moves them to
+    device once per batch."""
+
+    node_names: List[str]
+    pod_keys: List[str]             # ns/name of pending pods, FIFO order
+
+    # node statics  (units: milliCPU, MiB, gpu, pod-slots)
+    alloc: np.ndarray               # [N, 4] f32
+    used0: np.ndarray               # [N, 4] f32  existing usage
+    used0_nonzero: np.ndarray       # [N, 2] f32  nonzero-floored cpu/mem
+    node_labels: np.ndarray         # [N, L] f32 (0/1)
+    node_ports0: np.ndarray         # [N, PT] f32
+    taints_nosched: np.ndarray      # [N, T] f32
+    taints_prefer: np.ndarray       # [N, T] f32
+    mem_pressure: np.ndarray        # [N] bool
+    node_valid: np.ndarray          # [N] bool (padding rows are invalid)
+    zone_id: np.ndarray             # [N] i32  (-1 = no zone); for spread
+    n_zones: int
+
+    # pod statics
+    req: np.ndarray                 # [P, 4] f32
+    nonzero_req: np.ndarray         # [P, 2] f32
+    sel_required: np.ndarray        # [P, L] f32  nodeSelector pairs
+    sel_count: np.ndarray           # [P] f32     number required
+    pod_ports: np.ndarray           # [P, PT] f32
+    tol_nosched: np.ndarray         # [P, T] f32  tolerated NoSchedule taints
+    tol_prefer: np.ndarray          # [P, T] f32
+    best_effort: np.ndarray         # [P] bool
+    host_req: np.ndarray            # [P] i32  required node index or -1
+    pod_valid: np.ndarray           # [P] bool
+
+    # node affinity (required terms): expression/term/set matmuls
+    expr_node: np.ndarray           # [E, N] f32  expression truth per node
+    term_expr: np.ndarray           # [TM, E] f32 term -> its expressions
+    term_expr_count: np.ndarray     # [TM] f32
+    pod_term: np.ndarray            # [P, TM] f32 pod -> its terms (ORed)
+    pod_has_affinity: np.ndarray    # [P] bool
+
+    # preferred node affinity (score): weighted term rows
+    pref_term_node: np.ndarray      # [PT2, N] f32 term truth per node
+    pref_weight: np.ndarray         # [PT2] f32
+    pod_pref_term: np.ndarray       # [P, PT2] f32
+
+    # spread groups
+    pod_group: np.ndarray           # [P] i32  group id for scoring (-1 none)
+    pod_in_group: np.ndarray        # [P, G] f32  membership when committed
+    group_counts0: np.ndarray       # [N, G] f32  existing matching pods
+    n_groups: int
+
+    # image locality
+    image_node_sizes: np.ndarray    # [N, I] f32 (MiB present per image)
+    pod_images: np.ndarray          # [P, I] f32
+
+    # inter-pod affinity (vs existing pods; static)
+    interpod_forbidden: np.ndarray  # [P, N] f32 (1 = blocked: anti/symmetry)
+    interpod_required_miss: np.ndarray  # [P, N] f32 (1 = hard affinity unmet)
+
+    n_real_nodes: int = 0
+    n_real_pods: int = 0
+
+    def arrays(self) -> dict:
+        """All ndarray fields, for device upload."""
+        return {k: v for k, v in self.__dict__.items()
+                if isinstance(v, np.ndarray)}
+
+
+# --- helpers -----------------------------------------------------------------
+
+def _labels_of(obj) -> Dict[str, str]:
+    return (obj.metadata.labels or {}) if obj.metadata else {}
+
+
+def _pod_req_vec(pod: api.Pod) -> Tuple[np.ndarray, np.ndarray]:
+    r = api.pod_resource_request(pod)
+    req = np.array([r[api.RESOURCE_CPU], r[api.RESOURCE_MEMORY] / MB,
+                    r[api.RESOURCE_GPU], 1.0], dtype=np.float32)
+    cpu = mem = 0.0
+    for c in (pod.spec.containers or []) if pod.spec else []:
+        cr = (c.resources.requests if c.resources and c.resources.requests else {})
+        from kubernetes_tpu.api.quantity import parse_cpu, parse_quantity
+        ccpu = parse_cpu(cr.get(api.RESOURCE_CPU, 0))
+        cmem = parse_quantity(cr.get(api.RESOURCE_MEMORY, 0))
+        cpu += ccpu if ccpu else DEFAULT_MILLI_CPU_REQUEST
+        mem += cmem if cmem else DEFAULT_MEMORY_REQUEST
+    return req, np.array([cpu, mem / MB], dtype=np.float32)
+
+
+def _pod_ports_set(pod: api.Pod):
+    out = set()
+    for c in (pod.spec.containers or []) if pod.spec else []:
+        for p in c.ports or []:
+            if p.host_port:
+                out.add((p.protocol or "TCP", p.host_port))
+    return out
+
+
+def _selector_signature(selectors: Sequence[labelsel.Selector], ns: str):
+    return (ns, tuple(sorted(str(s) for s in selectors)))
+
+
+class Tensorizer:
+    """Builds ClusterTensors from (nodes, existing pods, pending pods).
+
+    The listers (service/RC/RS) are consulted per pending pod to derive its
+    spread group, mirroring SelectorSpread's lister usage."""
+
+    def __init__(self, plugin_args=None,
+                 failure_domains=(api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION)):
+        self.args = plugin_args
+        self.failure_domains = tuple(failure_domains)
+
+    # -- public ---------------------------------------------------------------
+
+    def build(self, nodes: List[api.Node], existing: List[api.Pod],
+              pending: List[api.Pod]) -> ClusterTensors:
+        N, P = len(nodes), len(pending)
+        # nodes are the lane (last) axis of every [P, N] matmul output: pad
+        # to the 128-lane TPU tile; pods are the sublane axis: pad to 8
+        Np, Pp = _pad(N, 128), _pad(P, 8)
+
+        label_vocab = Vocab()
+        for node in nodes:
+            for kv in _labels_of(node).items():
+                label_vocab.id(kv)
+        # collect label pairs referenced by pod selectors too (so unmatched
+        # requirements still get a column and fail cleanly)
+        for pod in pending:
+            for kv in ((pod.spec.node_selector or {}) if pod.spec else {}).items():
+                label_vocab.id(kv)
+
+        taint_vocab = Vocab()
+        for node in nodes:
+            for t in ((node.spec.taints or []) if node.spec else []):
+                taint_vocab.id((t.key, t.value, t.effect))
+
+        port_vocab = Vocab()
+        for pod in list(existing) + list(pending):
+            for pp in _pod_ports_set(pod):
+                port_vocab.id(pp)
+
+        image_vocab = Vocab()
+        for pod in pending:
+            for c in (pod.spec.containers or []) if pod.spec else []:
+                if c.image:
+                    image_vocab.id(c.image)
+
+        zone_vocab = Vocab()
+
+        # --- nodes -----------------------------------------------------------
+        L = _pad(len(label_vocab), 128)
+        T = _pad(len(taint_vocab), 128)
+        PT = _pad(len(port_vocab), 128)
+        I = _pad(len(image_vocab), 128)
+
+        alloc = np.zeros((Np, 4), np.float32)
+        node_labels = np.zeros((Np, L), np.float32)
+        taints_ns = np.zeros((Np, T), np.float32)
+        taints_pref = np.zeros((Np, T), np.float32)
+        mem_pressure = np.zeros(Np, bool)
+        node_valid = np.zeros(Np, bool)
+        zone_id = np.full(Np, -1, np.int32)
+        image_node_sizes = np.zeros((Np, I), np.float32)
+        node_index = {}
+
+        for i, node in enumerate(nodes):
+            node_index[node.metadata.name] = i
+            node_valid[i] = True
+            a = api.node_allocatable(node)
+            alloc[i] = (a[api.RESOURCE_CPU], a[api.RESOURCE_MEMORY] / MB,
+                        a[api.RESOURCE_GPU], a[api.RESOURCE_PODS])
+            for kv in _labels_of(node).items():
+                node_labels[i, label_vocab.id(kv)] = 1.0
+            for t in ((node.spec.taints or []) if node.spec else []):
+                tid = taint_vocab.id((t.key, t.value, t.effect))
+                if t.effect == api.TAINT_NO_SCHEDULE:
+                    taints_ns[i, tid] = 1.0
+                elif t.effect == api.TAINT_PREFER_NO_SCHEDULE:
+                    taints_pref[i, tid] = 1.0
+            for cond in ((node.status.conditions or []) if node.status else []):
+                if cond.type == api.NODE_MEMORY_PRESSURE and cond.status == api.CONDITION_TRUE:
+                    mem_pressure[i] = True
+            zk = _zone_key(node)
+            if zk:
+                zone_id[i] = zone_vocab.id(zk)
+            for img in ((node.status.images or []) if node.status else []):
+                for name in (img.names or []):
+                    iid = image_vocab.get(name)
+                    if iid is not None:
+                        image_node_sizes[i, iid] = img.size_bytes / MB
+
+        # --- existing usage --------------------------------------------------
+        used0 = np.zeros((Np, 4), np.float32)
+        used0_nz = np.zeros((Np, 2), np.float32)
+        node_ports0 = np.zeros((Np, PT), np.float32)
+        for pod in existing:
+            n = node_index.get(pod.spec.node_name if pod.spec else "")
+            if n is None:
+                continue
+            rq, nz = _pod_req_vec(pod)
+            used0[n] += rq
+            used0_nz[n] += nz
+            for pp in _pod_ports_set(pod):
+                node_ports0[n, port_vocab.id(pp)] = 1.0
+
+        # --- pending pods ----------------------------------------------------
+        req = np.zeros((Pp, 4), np.float32)
+        nonzero_req = np.zeros((Pp, 2), np.float32)
+        sel_required = np.zeros((Pp, L), np.float32)
+        pod_ports = np.zeros((Pp, PT), np.float32)
+        tol_ns = np.zeros((Pp, T), np.float32)
+        tol_pref = np.zeros((Pp, T), np.float32)
+        best_effort = np.zeros(Pp, bool)
+        host_req = np.full(Pp, -1, np.int32)
+        pod_valid = np.zeros(Pp, bool)
+        pod_images = np.zeros((Pp, I), np.float32)
+
+        for p, pod in enumerate(pending):
+            pod_valid[p] = True
+            req[p], nonzero_req[p] = _pod_req_vec(pod)
+            for kv in ((pod.spec.node_selector or {}) if pod.spec else {}).items():
+                sel_required[p, label_vocab.id(kv)] = 1.0
+            for pp in _pod_ports_set(pod):
+                pod_ports[p, port_vocab.id(pp)] = 1.0
+            best_effort[p] = _is_best_effort(pod)
+            want = pod.spec.node_name if pod.spec else ""
+            if want:
+                host_req[p] = node_index.get(want, -2)  # -2: named unknown node
+            for taint, tid in taint_vocab.items():
+                t = api.Taint(key=taint[0], value=taint[1], effect=taint[2])
+                for tol in ((pod.spec.tolerations or []) if pod.spec else []):
+                    if tol.tolerates(t):
+                        if t.effect == api.TAINT_NO_SCHEDULE:
+                            tol_ns[p, tid] = 1.0
+                        elif t.effect == api.TAINT_PREFER_NO_SCHEDULE:
+                            tol_pref[p, tid] = 1.0
+                        break
+            for c in (pod.spec.containers or []) if pod.spec else []:
+                iid = image_vocab.get(c.image)
+                if iid is not None:
+                    pod_images[p, iid] = 1.0
+
+        sel_count = sel_required.sum(axis=1)
+
+        # --- node affinity ---------------------------------------------------
+        (expr_node, term_expr, term_expr_count, pod_term, pod_has_aff,
+         pref_term_node, pref_weight, pod_pref_term) = self._affinity_tensors(
+            nodes, pending, node_labels, label_vocab, Np, Pp)
+
+        # --- spread groups ---------------------------------------------------
+        pod_group, pod_in_group, group_counts0, n_groups = self._spread_tensors(
+            nodes, existing, pending, node_index, Np, Pp)
+
+        # --- inter-pod (vs existing, static) ---------------------------------
+        forbidden, required_miss = self._interpod_static(
+            nodes, existing, pending, node_index, Np, Pp)
+
+        return ClusterTensors(
+            node_names=[n.metadata.name for n in nodes],
+            pod_keys=[f"{p.metadata.namespace}/{p.metadata.name}" for p in pending],
+            alloc=alloc, used0=used0, used0_nonzero=used0_nz,
+            node_labels=node_labels, node_ports0=node_ports0,
+            taints_nosched=taints_ns, taints_prefer=taints_pref,
+            mem_pressure=mem_pressure, node_valid=node_valid,
+            zone_id=zone_id, n_zones=max(len(zone_vocab), 1),
+            req=req, nonzero_req=nonzero_req,
+            sel_required=sel_required, sel_count=sel_count,
+            pod_ports=pod_ports, tol_nosched=tol_ns, tol_prefer=tol_pref,
+            best_effort=best_effort, host_req=host_req, pod_valid=pod_valid,
+            expr_node=expr_node, term_expr=term_expr,
+            term_expr_count=term_expr_count, pod_term=pod_term,
+            pod_has_affinity=pod_has_aff,
+            pref_term_node=pref_term_node, pref_weight=pref_weight,
+            pod_pref_term=pod_pref_term,
+            pod_group=pod_group, pod_in_group=pod_in_group,
+            group_counts0=group_counts0, n_groups=n_groups,
+            image_node_sizes=image_node_sizes, pod_images=pod_images,
+            interpod_forbidden=forbidden, interpod_required_miss=required_miss,
+            n_real_nodes=N, n_real_pods=P,
+        )
+
+    # -- node affinity --------------------------------------------------------
+
+    def _affinity_tensors(self, nodes, pending, node_labels, label_vocab,
+                          Np, Pp):
+        """Compile required + preferred NodeAffinity into matmul operands.
+        Expressions are deduped across the batch (RC-stamped pods share
+        them), so E and TM stay tiny even for 30k pods."""
+        expr_vocab = Vocab()     # canonical expression -> row
+        expr_rows: List[np.ndarray] = []
+        term_vocab = Vocab()     # tuple(expr ids) -> term row
+        term_exprs: List[List[int]] = []
+        pod_terms: List[List[int]] = []
+        has_aff = np.zeros(Pp, bool)
+
+        node_label_maps = [
+            _labels_of(n) for n in nodes]
+
+        def expr_id(e: api.NodeSelectorRequirement) -> int:
+            key = (e.key, e.operator, tuple(e.values or ()))
+            i = expr_vocab.get(key)
+            if i is not None:
+                return i
+            i = expr_vocab.id(key)
+            row = np.zeros(Np, np.float32)
+            req = labelsel.Requirement(e.key, e.operator, tuple(e.values or ()))
+            for n, lbls in enumerate(node_label_maps):
+                if req.matches(lbls):
+                    row[n] = 1.0
+            expr_rows.append(row)
+            return i
+
+        def term_id(t: api.NodeSelectorTerm) -> int:
+            eids = tuple(sorted(expr_id(e) for e in (t.match_expressions or [])))
+            i = term_vocab.get(eids)
+            if i is not None:
+                return i
+            i = term_vocab.id(eids)
+            term_exprs.append(list(eids))
+            return i
+
+        pref_entries: List[Tuple[int, float]] = []   # (term row id, weight)
+        pod_prefs: List[List[int]] = []
+
+        for p, pod in enumerate(pending):
+            aff = pod.spec.affinity if pod.spec else None
+            na = aff.node_affinity if aff else None
+            req = na.required_during_scheduling_ignored_during_execution if na else None
+            tids: List[int] = []
+            if req is not None:
+                has_aff[p] = True
+                for t in (req.node_selector_terms or []):
+                    tids.append(term_id(t))
+            pod_terms.append(tids)
+            prefs: List[int] = []
+            for pref in ((na.preferred_during_scheduling_ignored_during_execution or [])
+                         if na else []):
+                if pref.weight and pref.preference is not None:
+                    pt = term_id(pref.preference)
+                    prefs.append(len(pref_entries))
+                    pref_entries.append((pt, float(pref.weight)))
+            pod_prefs.append(prefs)
+
+        E = _pad(len(expr_rows), 8)
+        TM = _pad(len(term_exprs), 8)
+        expr_node = np.zeros((E, Np), np.float32)
+        for i, row in enumerate(expr_rows):
+            expr_node[i] = row
+        term_expr = np.zeros((TM, E), np.float32)
+        term_count = np.zeros(TM, np.float32)
+        for i, eids in enumerate(term_exprs):
+            for e in eids:
+                term_expr[i, e] = 1.0
+            term_count[i] = len(eids)
+        pod_term = np.zeros((Pp, TM), np.float32)
+        for p, tids in enumerate(pod_terms):
+            for t in tids:
+                pod_term[p, t] = 1.0
+
+        PT2 = _pad(len(pref_entries), 8)
+        pref_term_node = np.zeros((PT2, Np), np.float32)
+        pref_weight = np.zeros(PT2, np.float32)
+        # term truth per node: all its exprs true
+        term_node = (term_expr @ expr_node) >= term_count[:, None]
+        for i, (tid, w) in enumerate(pref_entries):
+            pref_term_node[i] = term_node[tid].astype(np.float32)
+            pref_weight[i] = w
+        pod_pref_term = np.zeros((Pp, PT2), np.float32)
+        for p, prefs in enumerate(pod_prefs):
+            for i in prefs:
+                pod_pref_term[p, i] = 1.0
+
+        return (expr_node, term_expr, term_count, pod_term, has_aff,
+                pref_term_node, pref_weight, pod_pref_term)
+
+    # -- spread ---------------------------------------------------------------
+
+    def _pod_selectors(self, pod: api.Pod) -> List[labelsel.Selector]:
+        if self.args is None:
+            return []
+        sels = []
+        if self.args.service_lister:
+            for svc in self.args.service_lister.get_pod_services(pod):
+                sels.append(labelsel.selector_from_map(svc.spec.selector))
+        if self.args.controller_lister:
+            for rc in self.args.controller_lister.get_pod_controllers(pod):
+                sels.append(labelsel.selector_from_map(rc.spec.selector))
+        if self.args.replicaset_lister:
+            for rs in self.args.replicaset_lister.get_pod_replica_sets(pod):
+                sels.append(labelsel.selector_from_label_selector(rs.spec.selector))
+        return sels
+
+    def _spread_tensors(self, nodes, existing, pending, node_index, Np, Pp):
+        group_vocab = Vocab()
+        group_selectors: List[Tuple[str, List[labelsel.Selector]]] = []
+        pod_group = np.full(Pp, -1, np.int32)
+        for p, pod in enumerate(pending):
+            sels = self._pod_selectors(pod)
+            if not sels:
+                continue
+            sig = _selector_signature(sels, pod.metadata.namespace)
+            gid = group_vocab.get(sig)
+            if gid is None:
+                gid = group_vocab.id(sig)
+                group_selectors.append((pod.metadata.namespace, sels))
+            pod_group[p] = gid
+
+        G = max(len(group_selectors), 1)
+        pod_in_group = np.zeros((Pp, G), np.float32)
+        for p, pod in enumerate(pending):
+            lbls = _labels_of(pod)
+            for g, (ns, sels) in enumerate(group_selectors):
+                if pod.metadata.namespace == ns and any(
+                        s.matches(lbls) for s in sels):
+                    pod_in_group[p, g] = 1.0
+
+        group_counts0 = np.zeros((Np, G), np.float32)
+        for pod in existing:
+            n = node_index.get(pod.spec.node_name if pod.spec else "")
+            if n is None or (pod.metadata and pod.metadata.deletion_timestamp):
+                continue
+            lbls = _labels_of(pod)
+            for g, (ns, sels) in enumerate(group_selectors):
+                if pod.metadata.namespace == ns and any(
+                        s.matches(lbls) for s in sels):
+                    group_counts0[n, g] += 1.0
+
+        return pod_group, pod_in_group, group_counts0, G
+
+    # -- inter-pod static -----------------------------------------------------
+
+    def _interpod_static(self, nodes, existing, pending, node_index, Np, Pp):
+        """Hard inter-pod (anti-)affinity against existing pods, plus
+        symmetry from existing pods' anti-affinity, as static [P, N] masks
+        (predicates.go:769-947). In-batch interactions are handled by the
+        scan carry (kernel.py) for anti-affinity self-spread terms."""
+        from kubernetes_tpu.scheduler.predicates import (
+            _pod_matches_term, _same_topology,
+        )
+        forbidden = np.zeros((Pp, Np), np.float32)
+        required_miss = np.zeros((Pp, Np), np.float32)
+        placed = [ep for ep in existing if ep.spec and ep.spec.node_name]
+
+        def nodes_in_domain_of(ep_node_name: str, topo_key: str) -> List[int]:
+            base = next((n for n in nodes if n.metadata.name == ep_node_name), None)
+            if base is None:
+                return []
+            return [node_index[n.metadata.name] for n in nodes
+                    if _same_topology(base, n, topo_key, self.failure_domains)]
+
+        # existing pods' anti-affinity (symmetry)
+        for ep in placed:
+            aff = ep.spec.affinity if ep.spec else None
+            anti = aff.pod_anti_affinity if aff else None
+            for term in ((anti.required_during_scheduling_ignored_during_execution or [])
+                         if anti else []):
+                blocked = None
+                for p, pod in enumerate(pending):
+                    if _pod_matches_term(pod, ep, term):
+                        if blocked is None:
+                            blocked = nodes_in_domain_of(ep.spec.node_name,
+                                                         term.topology_key)
+                        forbidden[p, blocked] = 1.0
+
+        for p, pod in enumerate(pending):
+            aff = pod.spec.affinity if pod.spec else None
+            if aff is None:
+                continue
+            anti_terms = ((aff.pod_anti_affinity.required_during_scheduling_ignored_during_execution or [])
+                          if aff.pod_anti_affinity else [])
+            for term in anti_terms:
+                for ep in placed:
+                    if _pod_matches_term(ep, pod, term):
+                        for n in nodes_in_domain_of(ep.spec.node_name,
+                                                    term.topology_key):
+                            forbidden[p, n] = 1.0
+            req_terms = ((aff.pod_affinity.required_during_scheduling_ignored_during_execution or [])
+                         if aff.pod_affinity else [])
+            for term in req_terms:
+                ok_nodes = set()
+                any_match = False
+                for ep in placed:
+                    if _pod_matches_term(ep, pod, term):
+                        any_match = True
+                        ok_nodes.update(nodes_in_domain_of(ep.spec.node_name,
+                                                           term.topology_key))
+                if not any_match:
+                    # disregard rule (predicates.go:818-844): self-selecting
+                    # term with no match anywhere may schedule
+                    if _pod_matches_term(pod, pod, term) and not any(
+                            _pod_matches_term(q, pod, term) for q in placed):
+                        continue
+                    required_miss[p, :] = 1.0
+                else:
+                    miss = np.ones(Np, np.float32)
+                    miss[list(ok_nodes)] = 0.0
+                    required_miss[p] = np.maximum(required_miss[p], miss)
+
+        return forbidden, required_miss
+
+
+def _zone_key(node: api.Node) -> str:
+    lbls = _labels_of(node)
+    region = lbls.get(api.LABEL_REGION, "")
+    zone = lbls.get(api.LABEL_ZONE, "")
+    if not region and not zone:
+        return ""
+    return f"{region}:{zone}"
+
+
+def _is_best_effort(pod: api.Pod) -> bool:
+    for c in (pod.spec.containers or []) if pod.spec else []:
+        if c.resources and (c.resources.requests or c.resources.limits):
+            return False
+    return True

@@ -1,0 +1,129 @@
+"""Batch scheduling backends: the TPU kernel and the sequential oracle.
+
+The TPU backend (BASELINE.json north star) schedules a whole pending-pod
+batch in one device program; the oracle runs the reference-semantics
+sequential loop (generic.py) over the same inputs and is the ground truth the
+kernel must match binding-for-binding (SURVEY §7 "what done means").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.ops.kernel import Weights, schedule_batch
+from kubernetes_tpu.ops.tensorize import Tensorizer
+from kubernetes_tpu.scheduler.cache import NodeInfo
+from kubernetes_tpu.scheduler.generic import FitError, GenericScheduler
+from kubernetes_tpu.scheduler.provider import PluginArgs, get_predicates, get_priorities
+
+
+DEFAULT_PREDICATE_KEYS = [
+    "NoDiskConflict", "GeneralPredicates", "PodToleratesNodeTaints",
+    "CheckNodeMemoryPressure", "MatchInterPodAffinity",
+]
+DEFAULT_PRIORITY_KEYS = [
+    "LeastRequestedPriority", "BalancedResourceAllocation",
+    "SelectorSpreadPriority", "NodeAffinityPriority", "TaintTolerationPriority",
+    "InterPodAffinityPriority",
+]
+
+
+class ListPodLister:
+    """Pod lister over a mutable list (committed pods get appended, so
+    predicates see in-batch assumes like the real cache-backed lister)."""
+
+    def __init__(self, pods: Optional[List[api.Pod]] = None):
+        self.pods = list(pods or [])
+
+    def list(self, selector=None):
+        if selector is None:
+            return list(self.pods)
+        return [p for p in self.pods
+                if selector.matches((p.metadata.labels or {}))]
+
+
+class ListServiceLister:
+    def __init__(self, services: Sequence[api.Service] = ()):
+        self.services = list(services)
+
+    def get_pod_services(self, pod):
+        out = []
+        lbls = (pod.metadata.labels or {})
+        for svc in self.services:
+            if svc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = svc.spec.selector if svc.spec else None
+            if sel and labelsel.selector_from_map(sel).matches(lbls):
+                out.append(svc)
+        return out
+
+
+class EmptyLister:
+    def get_pod_controllers(self, pod):
+        return []
+
+    def get_pod_replica_sets(self, pod):
+        return []
+
+    def get_pod_services(self, pod):
+        return []
+
+    def list(self, selector=None):
+        return []
+
+
+def make_plugin_args(nodes: List[api.Node], pod_lister=None,
+                     service_lister=None, controller_lister=None,
+                     replicaset_lister=None) -> PluginArgs:
+    node_map = {n.metadata.name: n for n in nodes}
+    empty = EmptyLister()
+    return PluginArgs(
+        pod_lister=pod_lister or ListPodLister(),
+        service_lister=service_lister or empty,
+        controller_lister=controller_lister or empty,
+        replicaset_lister=replicaset_lister or empty,
+        node_lookup=node_map.get,
+    )
+
+
+def oracle_batch(nodes: List[api.Node], existing: List[api.Pod],
+                 pending: List[api.Pod], args: PluginArgs,
+                 predicate_keys=None, priority_keys=None) -> List[Optional[str]]:
+    """Sequential reference loop: schedule each pod in FIFO order, assuming
+    each placement into the world model before the next (scheduler.go:93 +
+    cache.go:101 semantics)."""
+    predicates = get_predicates(predicate_keys or DEFAULT_PREDICATE_KEYS, args)
+    priorities = get_priorities(priority_keys or DEFAULT_PRIORITY_KEYS, args)
+    sched = GenericScheduler(predicates, priorities, parallel=False)
+
+    info: Dict[str, NodeInfo] = {n.metadata.name: NodeInfo(n) for n in nodes}
+    for ep in existing:
+        name = ep.spec.node_name if ep.spec else ""
+        if name in info:
+            info[name].add_pod(ep)
+
+    out: List[Optional[str]] = []
+    for pod in pending:
+        try:
+            host = sched.schedule(pod, info, nodes)
+        except FitError:
+            out.append(None)
+            continue
+        out.append(host)
+        committed = deep_copy(pod)
+        committed.spec.node_name = host
+        info[host].add_pod(committed)
+        if isinstance(args.pod_lister, ListPodLister):
+            args.pod_lister.pods.append(committed)
+    return out
+
+
+def tpu_batch(nodes: List[api.Node], existing: List[api.Pod],
+              pending: List[api.Pod], args: PluginArgs,
+              weights: Optional[Weights] = None) -> List[Optional[str]]:
+    """The TPU path: tensorize + device kernel."""
+    ct = Tensorizer(plugin_args=args).build(nodes, existing, pending)
+    return schedule_batch(ct, weights)

@@ -20,6 +20,7 @@ import time as _time
 from typing import Callable, Dict, List, Optional
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import parse_cpu, parse_quantity
 from kubernetes_tpu.client.cache import meta_namespace_key
 
 # Non-zero request defaults (reference plugin/pkg/scheduler/algorithm/
@@ -58,7 +59,6 @@ def pod_nonzero_request(pod: api.Pod) -> Resource:
     cpu = mem = 0
     for c in (pod.spec.containers if pod.spec and pod.spec.containers else []):
         req = (c.resources.requests if c.resources and c.resources.requests else {})
-        from kubernetes_tpu.api.quantity import parse_cpu, parse_quantity
         ccpu = parse_cpu(req.get(api.RESOURCE_CPU, 0))
         cmem = parse_quantity(req.get(api.RESOURCE_MEMORY, 0))
         cpu += ccpu if ccpu else DEFAULT_MILLI_CPU_REQUEST

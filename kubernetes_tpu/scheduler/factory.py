@@ -171,7 +171,7 @@ class Scheduler:
     def __init__(self, factory: ConfigFactory, algorithm):
         self.f = factory
         self.algorithm = algorithm
-        self.recorder = EventRecorder(factory.client, "default-scheduler")
+        self.recorder = EventRecorder(factory.client, factory.scheduler_name)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._cleanup_thread: Optional[threading.Thread] = None
@@ -190,20 +190,21 @@ class Scheduler:
             nodes = self.f.node_lister.list()
             with METRICS.time("scheduler_scheduling_algorithm_latency_seconds"):
                 dest = self.algorithm.schedule(pod, info, nodes)
-        except (FitError, Exception) as e:
+        except Exception as e:  # FitError and scheduler bugs both requeue
             self._handle_failure(pod, e)
             return True
         # optimistic assume before the async bind (scheduler.go:120-126)
         assumed = _with_node(pod, dest)
         try:
             self.f.cache.assume_pod(assumed)
+            did_assume = True
         except ValueError:
-            pass  # already cached (e.g. repeated requeue race); bind anyway
-        threading.Thread(target=self._bind, args=(pod, dest, t_start),
+            did_assume = False  # already cached (requeue race); bind anyway
+        threading.Thread(target=self._bind, args=(pod, dest, t_start, did_assume),
                          daemon=True).start()
         return True
 
-    def _bind(self, pod: api.Pod, dest: str, t_start: float):
+    def _bind(self, pod: api.Pod, dest: str, t_start: float, did_assume: bool):
         binding = api.Binding(
             metadata=api.ObjectMeta(name=pod.metadata.name,
                                     namespace=pod.metadata.namespace),
@@ -211,10 +212,14 @@ class Scheduler:
         try:
             with METRICS.time("scheduler_binding_latency_seconds"):
                 self.f.client.bind(binding, pod.metadata.namespace)
-        except ApiError as e:
+        except Exception as e:
+            # transport errors too — a dead bind thread with no rollback
+            # would strand the pod booked-but-unbound until TTL expiry
             log.warning("binding failed for %s: %s", pod.metadata.name, e)
-            # roll the assume back immediately; requeue with backoff
-            self.f.cache.remove_pod(_with_node(pod, dest))
+            if did_assume:
+                # roll our own assume back; never evict informer-confirmed
+                # state booked by an earlier successful bind
+                self.f.cache.remove_pod(_with_node(pod, dest))
             self._handle_failure(pod, e)
             return
         METRICS.observe("scheduler_e2e_scheduling_latency_seconds",

@@ -86,6 +86,13 @@ class GenericScheduler:
         failures: Dict[str, str] = {}
         lock = threading.Lock()
 
+        # per-decision precomputation (predicate metadata): one snapshot,
+        # not one per node under the parallel filter
+        for pred in self.predicates.values():
+            begin = getattr(pred, "begin_pod", None)
+            if begin is not None:
+                begin(pod)
+
         def check(node: api.Node) -> Optional[api.Node]:
             ni = info.get(node.metadata.name) or NodeInfo(node)
             for name, pred in self.predicates.items():

@@ -145,7 +145,9 @@ class SelectorSpread:
             if max_by_node > 0:
                 fscore = MAX_PRIORITY * (
                     (max_by_node - counts.get(node.metadata.name, 0)) / max_by_node)
-            if zone_counts:
+            # max_by_zone == 0 with zones present would be 0/0 (the reference
+            # hits float32 NaN here); canonical semantics: skip the blend
+            if zone_counts and max_by_zone > 0:
                 zk = _zone_key(node)
                 if zk:
                     zscore = MAX_PRIORITY * ((max_by_zone - zone_counts[zk]) / max_by_zone)

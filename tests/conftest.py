@@ -2,12 +2,17 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
 exercised without TPU hardware (the driver separately dry-runs the multichip
-path; real-chip benching happens via bench.py). Must be set before jax import.
+path; real-chip benching happens via bench.py). Must run before jax import.
+
+Note: the environment's axon sitecustomize force-registers the TPU platform
+when PALLAS_AXON_POOL_IPS is set, overriding JAX_PLATFORMS — drop it so
+pytest genuinely runs on the CPU mesh and never monopolizes the chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

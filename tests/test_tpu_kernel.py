@@ -1,0 +1,372 @@
+"""Differential tests: TPU kernel vs the sequential oracle.
+
+The contract (BASELINE.json): identical bindings, pod for pod, over the
+default provider's predicate+priority semantics. Runs on the virtual CPU
+mesh (conftest); bench.py runs the same kernel on the real chip."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.ops.kernel import Weights, schedule_batch
+from kubernetes_tpu.ops.tensorize import Tensorizer
+from kubernetes_tpu.scheduler.batch import (
+    ListPodLister, ListServiceLister, make_plugin_args, oracle_batch, tpu_batch,
+)
+
+
+def mk_node(name, cpu="4", mem="32Gi", pods="110", labels=None, taints=None,
+            conditions=None):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels),
+        spec=api.NodeSpec(taints=taints),
+        status=api.NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": pods},
+            conditions=conditions or [api.NodeCondition(type="Ready", status="True")]))
+
+
+def mk_pod(name, ns="default", cpu=None, mem=None, labels=None, node="",
+           selector=None, affinity=None, tolerations=None, host_ports=()):
+    requests = {}
+    if cpu:
+        requests["cpu"] = cpu
+    if mem:
+        requests["memory"] = mem
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(
+            node_name=node, node_selector=selector, affinity=affinity,
+            tolerations=tolerations,
+            containers=[api.Container(
+                name="c", image="pause",
+                ports=[api.ContainerPort(host_port=p, container_port=p)
+                       for p in host_ports],
+                resources=api.ResourceRequirements(requests=requests)
+                if requests else None)]))
+
+
+def assert_same(nodes, existing, pending, args_oracle, args_tpu, **kw):
+    got_oracle = oracle_batch(nodes, existing, pending, args_oracle, **kw)
+    got_tpu = tpu_batch(nodes, existing, pending, args_tpu)
+    assert got_tpu == got_oracle, (
+        f"kernel disagrees with oracle:\n  oracle: {got_oracle}\n  tpu:    {got_tpu}")
+    return got_oracle
+
+
+def two_args(nodes, existing=(), services=()):
+    """Fresh plugin args for each backend (oracle mutates its pod lister)."""
+    def mk():
+        return make_plugin_args(
+            nodes, pod_lister=ListPodLister(list(existing)),
+            service_lister=ListServiceLister(services))
+    return mk(), mk()
+
+
+class TestDifferentialBasic:
+    def test_empty_cluster_spreads_by_least_requested(self):
+        nodes = [mk_node(f"n{i}") for i in range(5)]
+        pending = [mk_pod(f"p{i}", cpu="500m", mem="1Gi") for i in range(20)]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert None not in got
+        assert len(set(got)) == 5  # all nodes used
+
+    def test_respects_existing_load(self):
+        nodes = [mk_node("busy"), mk_node("idle")]
+        existing = [mk_pod(f"e{i}", cpu="1", mem="8Gi", node="busy") for i in range(3)]
+        pending = [mk_pod("p", cpu="100m", mem="100Mi")]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["idle"]
+
+    def test_capacity_exhaustion_and_unschedulable(self):
+        nodes = [mk_node("n1", cpu="1", pods="4")]
+        pending = [mk_pod(f"p{i}", cpu="400m") for i in range(4)]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got[:2] == ["n1", "n1"] and got[2:] == [None, None]
+
+    def test_pod_count_cap(self):
+        nodes = [mk_node("n1", pods="2"), mk_node("n2", pods="2")]
+        pending = [mk_pod(f"p{i}") for i in range(6)]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got.count(None) == 2
+
+    def test_round_robin_ties(self):
+        nodes = [mk_node(f"n{i}") for i in range(3)]
+        pending = [mk_pod(f"p{i}") for i in range(6)]  # no requests: all tie
+        a, b = two_args(nodes)
+        assert_same(nodes, [], pending, a, b)
+
+    def test_zero_request_on_overcommitted_node(self):
+        nodes = [mk_node("n1", cpu="1", pods="10")]
+        existing = [mk_pod("e", cpu="2", node="n1")]  # overcommitted externally
+        pending = [mk_pod("z")]  # zero requests: passes resources, count ok
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["n1"]
+
+
+class TestDifferentialPredicates:
+    def test_node_selector(self):
+        nodes = [mk_node("plain"), mk_node("ssd", labels={"disk": "ssd"})]
+        pending = [mk_pod("p", selector={"disk": "ssd"}),
+                   mk_pod("q", selector={"disk": "none"})]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got == ["ssd", None]
+
+    def test_host_pinning(self):
+        nodes = [mk_node("n1"), mk_node("n2")]
+        pending = [mk_pod("p", node="n2"), mk_pod("q", node="ghost")]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got == ["n2", None]
+
+    def test_taints(self):
+        taint = api.Taint(key="dedicated", value="ml", effect="NoSchedule")
+        nodes = [mk_node("tainted", cpu="8", taints=[taint]), mk_node("plain", cpu="2")]
+        tol = [api.Toleration(key="dedicated", operator="Exists")]
+        pending = [mk_pod("p"), mk_pod("ml", tolerations=tol, cpu="4")]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got == ["plain", "tainted"]
+
+    def test_host_ports_dynamic(self):
+        """Second pod with the same hostPort must go elsewhere — in-batch
+        port booking."""
+        nodes = [mk_node("n1"), mk_node("n2")]
+        pending = [mk_pod("p1", host_ports=(8080,)), mk_pod("p2", host_ports=(8080,)),
+                   mk_pod("p3", host_ports=(8080,))]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert set(got[:2]) == {"n1", "n2"} and got[2] is None
+
+    def test_memory_pressure_gates_besteffort(self):
+        pressured = mk_node("pressured", conditions=[
+            api.NodeCondition(type="Ready", status="True"),
+            api.NodeCondition(type="MemoryPressure", status="True")])
+        nodes = [pressured, mk_node("ok", cpu="1")]
+        pending = [mk_pod("be"), mk_pod("burst", cpu="100m")]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got[0] == "ok"
+
+    def test_node_affinity_required(self):
+        nodes = [mk_node("a", labels={"zone": "us-a"}),
+                 mk_node("b", labels={"zone": "us-b"})]
+        aff = api.Affinity(node_affinity=api.NodeAffinity(
+            required_during_scheduling_ignored_during_execution=api.NodeSelector(
+                node_selector_terms=[api.NodeSelectorTerm(match_expressions=[
+                    api.NodeSelectorRequirement(key="zone", operator="In",
+                                                values=["us-b"])])])))
+        pending = [mk_pod("p", affinity=aff)]
+        a, b = two_args(nodes)
+        assert assert_same(nodes, [], pending, a, b) == ["b"]
+
+    @pytest.mark.parametrize("op,values,expect", [
+        ("NotIn", ["us-a"], "b"),
+        ("Exists", None, "a"),          # only "a" has the label... see body
+        ("DoesNotExist", None, "b"),
+        ("Gt", ["5"], "b"),
+        ("Lt", ["5"], "a"),
+    ])
+    def test_node_affinity_operators(self, op, values, expect):
+        nodes = [mk_node("a", labels={"cores": "2", "zone": "us-a"}),
+                 mk_node("b", labels={"cores": "8"})]
+        key = "zone" if op in ("NotIn", "Exists", "DoesNotExist") else "cores"
+        aff = api.Affinity(node_affinity=api.NodeAffinity(
+            required_during_scheduling_ignored_during_execution=api.NodeSelector(
+                node_selector_terms=[api.NodeSelectorTerm(match_expressions=[
+                    api.NodeSelectorRequirement(key=key, operator=op,
+                                                values=values)])])))
+        pending = [mk_pod("p", affinity=aff)]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got == [expect]
+
+
+class TestDifferentialPriorities:
+    def test_preferred_node_affinity(self):
+        nodes = [mk_node("a", labels={"disk": "ssd"}), mk_node("b")]
+        aff = api.Affinity(node_affinity=api.NodeAffinity(
+            preferred_during_scheduling_ignored_during_execution=[
+                api.PreferredSchedulingTerm(weight=50, preference=api.NodeSelectorTerm(
+                    match_expressions=[api.NodeSelectorRequirement(
+                        key="disk", operator="In", values=["ssd"])]))]))
+        pending = [mk_pod("p", affinity=aff, cpu="100m")]
+        a, b = two_args(nodes)
+        assert assert_same(nodes, [], pending, a, b) == ["a"]
+
+    def test_prefer_no_schedule_avoidance(self):
+        nodes = [mk_node("t", taints=[api.Taint(key="x", value="y",
+                                                effect="PreferNoSchedule")]),
+                 mk_node("clean")]
+        pending = [mk_pod("p", cpu="100m")]
+        a, b = two_args(nodes)
+        assert assert_same(nodes, [], pending, a, b) == ["clean"]
+
+    def test_selector_spread_with_service(self):
+        nodes = [mk_node(f"n{i}") for i in range(3)]
+        svc = api.Service(metadata=api.ObjectMeta(name="web", namespace="default"),
+                          spec=api.ServiceSpec(selector={"app": "web"},
+                                               ports=[api.ServicePort(port=80)]))
+        existing = [mk_pod("e1", labels={"app": "web"}, node="n0", cpu="100m")]
+        pending = [mk_pod(f"w{i}", labels={"app": "web"}, cpu="100m")
+                   for i in range(4)]
+        a, b = two_args(nodes, existing, services=[svc])
+        got = assert_same(nodes, existing, pending, a, b)
+        # spreading balances totals: n0 already holds the existing pod, so
+        # every node ends with at least one service pod and at most two
+        totals = {"n0": 1, "n1": 0, "n2": 0}
+        for h in got:
+            totals[h] += 1
+        assert all(1 <= c <= 2 for c in totals.values()), totals
+
+    def test_zone_aware_spread(self):
+        za, zb = {api.LABEL_ZONE: "us-a"}, {api.LABEL_ZONE: "us-b"}
+        nodes = [mk_node("a1", labels=za), mk_node("a2", labels=za),
+                 mk_node("b1", labels=zb)]
+        svc = api.Service(metadata=api.ObjectMeta(name="web", namespace="default"),
+                          spec=api.ServiceSpec(selector={"app": "web"},
+                                               ports=[api.ServicePort(port=80)]))
+        existing = [mk_pod("e1", labels={"app": "web"}, node="a1", cpu="100m")]
+        pending = [mk_pod("w1", labels={"app": "web"}, cpu="100m")]
+        a, b = two_args(nodes, existing, services=[svc])
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["b1"]  # other zone wins via 2/3 zone weighting
+
+
+class TestDifferentialInterPod:
+    def test_anti_affinity_vs_existing(self):
+        h = api.LABEL_HOSTNAME
+        nodes = [mk_node("n1", labels={h: "n1"}), mk_node("n2", labels={h: "n2"})]
+        existing = [mk_pod("e", labels={"app": "web"}, node="n1", cpu="100m")]
+        anti = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(match_labels={"app": "web"}),
+                    topology_key=h)]))
+        pending = [mk_pod("p", labels={"app": "other"}, affinity=anti, cpu="100m")]
+        a, b = two_args(nodes, existing)
+        assert assert_same(nodes, existing, pending, a, b) == ["n2"]
+
+    def test_symmetry_existing_anti_affinity(self):
+        h = api.LABEL_HOSTNAME
+        nodes = [mk_node("n1", labels={h: "n1"}), mk_node("n2", labels={h: "n2"})]
+        lonely_anti = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(match_labels={"app": "web"}),
+                    topology_key=h)]))
+        existing = [mk_pod("lonely", labels={"app": "solo"}, node="n1",
+                           affinity=lonely_anti, cpu="100m")]
+        pending = [mk_pod("w", labels={"app": "web"}, cpu="100m")]
+        a, b = two_args(nodes, existing)
+        assert assert_same(nodes, existing, pending, a, b) == ["n2"]
+
+    def test_required_affinity_zone_vs_existing(self):
+        za, zb = {api.LABEL_ZONE: "us-a"}, {api.LABEL_ZONE: "us-b"}
+        nodes = [mk_node("a1", labels=za), mk_node("a2", labels=za),
+                 mk_node("b1", labels=zb)]
+        existing = [mk_pod("db", labels={"app": "db"}, node="a1", cpu="100m")]
+        aff = api.Affinity(pod_affinity=api.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(match_labels={"app": "db"}),
+                    topology_key=api.LABEL_ZONE)]))
+        pending = [mk_pod("web", labels={"app": "web"}, affinity=aff, cpu="100m")]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got[0] in ("a1", "a2")  # same zone as db
+
+
+class TestDifferentialRandomized:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_random_cluster(self, seed):
+        rng = random.Random(seed)
+        zones = ["us-a", "us-b", "us-c"]
+        nodes = []
+        for i in range(24):
+            labels = {api.LABEL_HOSTNAME: f"n{i:02d}",
+                      api.LABEL_ZONE: rng.choice(zones)}
+            if rng.random() < 0.3:
+                labels["disk"] = rng.choice(["ssd", "hdd"])
+            taints = ([api.Taint(key="dedicated", value="ml", effect="NoSchedule")]
+                      if rng.random() < 0.15 else None)
+            nodes.append(mk_node(
+                f"n{i:02d}", cpu=rng.choice(["2", "4", "8"]),
+                mem=rng.choice(["8Gi", "16Gi", "32Gi"]),
+                pods=str(rng.choice([8, 16, 110])), labels=labels, taints=taints))
+        existing = []
+        for i in range(30):
+            n = rng.choice(nodes)
+            existing.append(mk_pod(
+                f"e{i:02d}", cpu=f"{rng.choice([100, 250, 500])}m",
+                mem=f"{rng.choice([128, 512, 1024])}Mi",
+                labels={"app": rng.choice(["web", "db", "cache"])},
+                node=n.metadata.name))
+        svc = api.Service(metadata=api.ObjectMeta(name="web", namespace="default"),
+                          spec=api.ServiceSpec(selector={"app": "web"},
+                                               ports=[api.ServicePort(port=80)]))
+        pending = []
+        for i in range(60):
+            kw = {"cpu": f"{rng.choice([100, 200, 500])}m",
+                  "mem": f"{rng.choice([128, 256, 512])}Mi",
+                  "labels": {"app": rng.choice(["web", "db", "cache"])}}
+            r = rng.random()
+            if r < 0.2:
+                kw["selector"] = {"disk": "ssd"}
+            elif r < 0.3:
+                kw["tolerations"] = [api.Toleration(key="dedicated", operator="Exists")]
+            elif r < 0.35:
+                kw["host_ports"] = (9000 + (i % 4),)
+            pending.append(mk_pod(f"p{i:02d}", **kw))
+        a, b = two_args(nodes, existing, services=[svc])
+        assert_same(nodes, existing, pending, a, b)
+
+
+class TestKernelMechanics:
+    def test_no_overcommit_invariant(self):
+        """Whatever the kernel assigns must satisfy capacity constraints."""
+        rng = random.Random(42)
+        nodes = [mk_node(f"n{i}", cpu="2", mem="4Gi", pods="10") for i in range(8)]
+        pending = [mk_pod(f"p{i}", cpu=f"{rng.choice([100, 500, 900])}m",
+                          mem=f"{rng.choice([256, 1024])}Mi") for i in range(64)]
+        args = make_plugin_args(nodes)
+        got = tpu_batch(nodes, [], pending, args)
+        used = {n.metadata.name: [0, 0, 0] for n in nodes}
+        for pod, host in zip(pending, got):
+            if host is None:
+                continue
+            r = api.pod_resource_request(pod)
+            used[host][0] += r[api.RESOURCE_CPU]
+            used[host][1] += r[api.RESOURCE_MEMORY]
+            used[host][2] += 1
+        for name, (cpu, mem, cnt) in used.items():
+            assert cpu <= 2000 and mem <= 4 * 2**30 and cnt <= 10, name
+
+    def test_padding_insensitive(self):
+        """Padded rows/columns must never be selected or affect choices."""
+        nodes = [mk_node(f"n{i}") for i in range(3)]   # padded to 128
+        pending = [mk_pod(f"p{i}", cpu="100m") for i in range(5)]  # padded to 8
+        args = make_plugin_args(nodes)
+        got = tpu_batch(nodes, [], pending, args)
+        assert all(g in {"n0", "n1", "n2"} for g in got)
+
+    def test_jit_cache_reuse(self):
+        """Same padded shapes -> no recompile (cache keyed by shape)."""
+        nodes = [mk_node(f"n{i}") for i in range(4)]
+        args = make_plugin_args(nodes)
+        t = Tensorizer(plugin_args=args)
+        import kubernetes_tpu.ops.kernel as K
+        ct1 = t.build(nodes, [], [mk_pod("a", cpu="1")])
+        ct2 = t.build(nodes, [], [mk_pod("b", cpu="2")])
+        r1 = schedule_batch(ct1)
+        size_before = K._schedule_jit._cache_size()
+        r2 = schedule_batch(ct2)
+        assert K._schedule_jit._cache_size() == size_before
+        assert r1[0] is not None and r2[0] is not None
