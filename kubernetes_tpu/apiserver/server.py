@@ -184,6 +184,11 @@ class _Handler(BaseHTTPRequestHandler):
         name = m.group("name")
         sub = m.group("sub")
 
+        # /api/v1/namespaces/{name}/status parses as ns + resource="status":
+        # reinterpret as the namespaces status subresource
+        if ns and resource == "status" and not name:
+            resource, name, sub, ns = "namespaces", ns, "status", ""
+
         # "bindings" is a virtual write-only resource backed by the pod
         # registry (reference BindingREST)
         if resource == "bindings" and method == "POST":

@@ -1,0 +1,142 @@
+"""Leader election: active/passive HA for scheduler & controller-manager.
+
+Parity target: reference pkg/client/leaderelection/leaderelection.go:81,170,
+241 — a CAS lease stored as an annotation on an Endpoints object:
+tryAcquireOrRenew reads the LeaderElectionRecord, takes the lease if absent/
+expired, renews if held, and the loop fires OnStartedLeading/OnStoppedLeading.
+Crash-only: a leader that stops renewing is superseded after lease_duration.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.rest import ApiError, RESTClient
+
+LEADER_ANNOTATION = "control-plane.alpha.kubernetes.io/leader"
+
+
+@dataclass
+class LeaderElectionConfig:
+    lock_namespace: str = "kube-system"
+    lock_name: str = "leader-lock"
+    identity: str = "unknown"
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+
+
+class LeaderElector:
+    def __init__(self, client: RESTClient, config: LeaderElectionConfig,
+                 on_started_leading: Callable[[], None],
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 clock=time.time):
+        self.client = client
+        self.cfg = config
+        self.on_started = on_started_leading
+        self.on_stopped = on_stopped_leading
+        self._clock = clock
+        self._stop = threading.Event()
+        self._is_leader = False
+        self._observed_record: Optional[dict] = None
+        self._observed_time = 0.0
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    # --- the CAS attempt (tryAcquireOrRenew, leaderelection.go:241) ----------
+
+    def try_acquire_or_renew(self) -> bool:
+        now = self._clock()
+        record = {
+            "holderIdentity": self.cfg.identity,
+            "leaseDurationSeconds": int(self.cfg.lease_duration),
+            "acquireTime": now,
+            "renewTime": now,
+        }
+        try:
+            ep = self.client.get("endpoints", self.cfg.lock_name,
+                                 self.cfg.lock_namespace)
+        except ApiError as e:
+            if not e.is_not_found:
+                return False
+            ep = api.Endpoints(metadata=api.ObjectMeta(
+                name=self.cfg.lock_name, namespace=self.cfg.lock_namespace,
+                annotations={LEADER_ANNOTATION: json.dumps(record)}))
+            try:
+                self.client.create("endpoints", ep, self.cfg.lock_namespace)
+            except ApiError:
+                return False
+            self._observe(record, now)
+            return True
+
+        ann = (ep.metadata.annotations or {})
+        raw = ann.get(LEADER_ANNOTATION)
+        old = json.loads(raw) if raw else None
+        if old is not None:
+            if old != self._observed_record:
+                self._observe(old, now)
+            held_by_other = old.get("holderIdentity") != self.cfg.identity
+            lease_valid = (self._observed_time + self.cfg.lease_duration) > now
+            if held_by_other and lease_valid:
+                return False  # someone else holds an unexpired lease
+            if not held_by_other:
+                record["acquireTime"] = old.get("acquireTime", now)
+        ep.metadata.annotations = dict(ann)
+        ep.metadata.annotations[LEADER_ANNOTATION] = json.dumps(record)
+        try:
+            self.client.update("endpoints", ep, self.cfg.lock_namespace)
+        except ApiError:
+            return False  # CAS lost: someone renewed concurrently
+        self._observe(record, now)
+        return True
+
+    def _observe(self, record: dict, now: float):
+        self._observed_record = record
+        self._observed_time = now
+
+    # --- loop (RunOrDie/acquire/renew, leaderelection.go:170) ----------------
+
+    def run(self):
+        self._thread = threading.Thread(target=self._loop, name="leader-elector",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        # acquire
+        while not self._stop.is_set():
+            if self.try_acquire_or_renew():
+                break
+            self._stop.wait(self.cfg.retry_period)
+        if self._stop.is_set():
+            return
+        self._is_leader = True
+        threading.Thread(target=self.on_started, daemon=True).start()
+        # renew
+        while not self._stop.is_set():
+            deadline = self._clock() + self.cfg.renew_deadline
+            renewed = False
+            while self._clock() < deadline and not self._stop.is_set():
+                if self.try_acquire_or_renew():
+                    renewed = True
+                    break
+                self._stop.wait(self.cfg.retry_period)
+            if not renewed:
+                break
+            self._stop.wait(self.cfg.retry_period)
+        self._is_leader = False
+        if self.on_stopped:
+            self.on_stopped()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,11 @@
+"""L6 reconciliation controllers.
+
+Parity target: reference pkg/controller (35.9k LoC) +
+cmd/kube-controller-manager — the informer + workqueue + reconcile pattern:
+watch desired state, compare to observed, converge. Inventory here:
+replication (replication_controller.py), endpoints (endpoints_controller.py),
+node lifecycle (node_controller.py), namespace cascade (namespace_controller.py),
+all composed by ControllerManager (manager.py) under leader election.
+"""
+
+from kubernetes_tpu.controllers.manager import ControllerManager

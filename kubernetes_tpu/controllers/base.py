@@ -1,0 +1,62 @@
+"""Controller base: informer feed -> keyed workqueue -> reconcile workers.
+
+Parity target: the shared shape of every reference controller
+(pkg/controller/*/: informer handlers enqueue keys, N workers pop and sync,
+errors re-enqueue rate-limited; controller_utils.go expectations are replaced
+by idempotent syncs against live reads)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.utils.workqueue import RateLimitingQueue
+
+log = logging.getLogger("controller")
+
+
+class Controller:
+    """Subclasses implement sync(key) -> None (raise to retry)."""
+
+    name = "controller"
+
+    def __init__(self, workers: int = 2):
+        self.queue = RateLimitingQueue()
+        self.workers = workers
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def enqueue(self, key: str):
+        self.queue.add(key)
+
+    def sync(self, key: str) -> None:
+        raise NotImplementedError
+
+    def run(self):
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, name=f"{self.name}-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _worker(self):
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.5)
+            if key is None:
+                continue
+            try:
+                self.sync(key)
+                self.queue.forget(key)
+            except Exception as e:
+                log.info("%s: sync %s failed: %s; requeueing", self.name, key, e)
+                self.queue.add_rate_limited(key)
+            finally:
+                self.queue.done(key)
+
+    def stop(self):
+        self._stop.set()
+        self.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=2)

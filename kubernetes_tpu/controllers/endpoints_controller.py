@@ -1,0 +1,141 @@
+"""Endpoints controller: services -> ready pod addresses.
+
+Parity target: reference pkg/controller/endpoint/endpoints_controller.go
+(519 ln) — for each service, gather pods matching its selector, split by
+readiness into addresses/notReadyAddresses, resolve target ports, and write
+the Endpoints object the proxy consumes."""
+
+from __future__ import annotations
+
+import logging
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+
+log = logging.getLogger("endpoints-controller")
+
+
+class EndpointsController(Controller):
+    name = "endpoints"
+
+    def __init__(self, client: RESTClient, workers: int = 2):
+        super().__init__(workers)
+        self.client = client
+        self.svc_informer = Informer(ListWatch(client, "services"))
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.svc_informer.add_event_handler(
+            on_add=lambda s: self.enqueue(_key(s)),
+            on_update=lambda o, n: self.enqueue(_key(n)),
+            on_delete=lambda s: self.enqueue(_key(s)))
+        self.pod_informer.add_event_handler(
+            on_add=self._pod_changed,
+            on_update=lambda o, n: self._pod_changed(n),
+            on_delete=self._pod_changed)
+
+    def _pod_changed(self, pod: api.Pod):
+        lbls = (pod.metadata.labels or {})
+        for svc in self.svc_informer.store.list():
+            if svc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = svc.spec.selector if svc.spec else None
+            if sel and labelsel.selector_from_map(sel).matches(lbls):
+                self.enqueue(_key(svc))
+
+    def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        svc = self.svc_informer.store.get(key)
+        if svc is None:
+            try:
+                self.client.delete("endpoints", name, ns)
+            except ApiError as e:
+                if not e.is_not_found:
+                    raise
+            return
+        if not (svc.spec and svc.spec.selector):
+            return  # headless/manual endpoints are user-managed
+        sel = labelsel.selector_from_map(svc.spec.selector)
+        ready, not_ready = [], []
+        sample_pod = None  # for named targetPort resolution
+        for pod in self.pod_informer.store.list():
+            if pod.metadata.namespace != ns:
+                continue
+            if not sel.matches(pod.metadata.labels or {}):
+                continue
+            if not (pod.status and pod.status.pod_ip):
+                continue
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            addr = api.EndpointAddress(
+                ip=pod.status.pod_ip,
+                node_name=pod.spec.node_name if pod.spec else None,
+                target_ref=api.ObjectReference(
+                    kind="Pod", namespace=ns, name=pod.metadata.name,
+                    uid=pod.metadata.uid))
+            (ready if _is_ready(pod) else not_ready).append(addr)
+            sample_pod = pod
+        ports = [api.EndpointPort(name=p.name, protocol=p.protocol or "TCP",
+                                  port=_target_port(p, sample_pod))
+                 for p in (svc.spec.ports or [])]
+        subsets = []
+        if ready or not_ready:
+            subsets = [api.EndpointSubset(
+                addresses=ready or None,
+                not_ready_addresses=not_ready or None,
+                ports=ports or None)]
+        desired = api.Endpoints(
+            metadata=api.ObjectMeta(name=name, namespace=ns),
+            subsets=subsets or None)
+        try:
+            current = self.client.get("endpoints", name, ns)
+            if current.subsets == desired.subsets:
+                return
+            current.subsets = desired.subsets
+            self.client.update("endpoints", current)
+        except ApiError as e:
+            if e.is_not_found:
+                self.client.create("endpoints", desired, ns)
+            elif not e.is_conflict:
+                raise
+
+    def start(self):
+        self.svc_informer.run()
+        self.pod_informer.run()
+        self.svc_informer.wait_for_sync()
+        self.pod_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.svc_informer.stop()
+        self.pod_informer.stop()
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def _is_ready(pod: api.Pod) -> bool:
+    for c in ((pod.status.conditions or []) if pod.status else []):
+        if c.type == api.POD_READY:
+            return c.status == api.CONDITION_TRUE
+    return False
+
+
+def _target_port(p: api.ServicePort, pod) -> int:
+    """Resolve targetPort: int as-is, numeric string parsed, named port
+    looked up in the pod's container ports (reference FindPort). Assumes
+    homogeneous pods behind a service (one subset), like the common case."""
+    tp = p.target_port
+    if isinstance(tp, int):
+        return tp
+    if isinstance(tp, str) and tp:
+        if tp.isdigit():
+            return int(tp)
+        for c in ((pod.spec.containers or []) if pod and pod.spec else []):
+            for cp in c.ports or []:
+                if cp.name == tp:
+                    return cp.container_port
+    return p.port

@@ -1,0 +1,65 @@
+"""Namespace controller: cascading deletion.
+
+Parity target: reference pkg/controller/namespace — a namespace with a
+deletionTimestamp is drained: every namespaced resource inside it is deleted,
+then the namespace itself is removed once empty."""
+
+from __future__ import annotations
+
+import logging
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.registry.generic import RESOURCES
+
+log = logging.getLogger("namespace-controller")
+
+
+class NamespaceController(Controller):
+    name = "namespace"
+
+    def __init__(self, client: RESTClient, workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.informer = Informer(ListWatch(client, "namespaces"))
+        self.informer.add_event_handler(
+            on_add=self._changed,
+            on_update=lambda o, n: self._changed(n))
+
+    def _changed(self, ns: api.Namespace):
+        if ns.metadata.deletion_timestamp is not None or (
+                ns.status and ns.status.phase == "Terminating"):
+            self.enqueue(ns.metadata.name)
+
+    def sync(self, key: str) -> None:
+        remaining = 0
+        for rname, rd in RESOURCES.items():
+            if not rd.namespaced:
+                continue
+            items, _ = self.client.list(rname, key)
+            for obj in items:
+                remaining += 1
+                try:
+                    self.client.delete(rname, obj.metadata.name, key)
+                except ApiError as e:
+                    if not e.is_not_found:
+                        raise
+        if remaining == 0:
+            try:
+                self.client.delete("namespaces", key)
+            except ApiError as e:
+                if not e.is_not_found:
+                    raise
+        else:
+            raise RuntimeError(f"namespace {key}: {remaining} objects drained; re-check")
+
+    def start(self):
+        self.informer.run()
+        self.informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.informer.stop()

@@ -1,0 +1,143 @@
+"""Node lifecycle controller: heartbeat monitoring + pod eviction.
+
+Parity target: reference pkg/controller/node/nodecontroller.go (1,077 ln) —
+monitor node heartbeats (NodeCondition Ready lastHeartbeatTime); after a
+grace period mark the node NotReady/Unknown; after the pod-eviction timeout,
+evict its pods through a rate-limited queue so a zone-wide blip doesn't mass-
+delete the cluster (zone-aware eviction limiting)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.utils.flowcontrol import TokenBucket
+from kubernetes_tpu.utils.timeutil import now_iso
+
+log = logging.getLogger("node-controller")
+
+
+class NodeController:
+    def __init__(self, client: RESTClient,
+                 monitor_period: float = 5.0,
+                 grace_period: float = 40.0,
+                 pod_eviction_timeout: float = 60.0,
+                 eviction_qps: float = 0.1,
+                 clock=time.time):
+        self.client = client
+        self.monitor_period = monitor_period
+        self.grace_period = grace_period
+        self.pod_eviction_timeout = pod_eviction_timeout
+        self.eviction_limiter = TokenBucket(qps=eviction_qps, burst=1)
+        self._clock = clock
+        self.node_informer = Informer(ListWatch(client, "nodes"))
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self._last_heartbeat: Dict[str, float] = {}
+        self._last_seen: Dict[str, float] = {}
+        self._not_ready_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- monitor loop --------------------------------------------------------
+
+    def monitor_once(self, now: Optional[float] = None):
+        now = now if now is not None else self._clock()
+        for node in self.node_informer.store.list():
+            name = node.metadata.name
+            hb = _heartbeat_of(node)
+            prev = self._last_heartbeat.get(name)
+            if hb != prev:
+                self._last_heartbeat[name] = hb
+                self._last_seen[name] = now
+            last_seen = self._last_seen.get(name, now)
+            ready = _is_ready(node)
+            if ready and now - last_seen <= self.grace_period:
+                self._not_ready_since.pop(name, None)
+                continue
+            # stale heartbeat or explicitly NotReady
+            since = self._not_ready_since.setdefault(name, now)
+            if now - last_seen > self.grace_period and ready:
+                self._mark_unknown(node)
+            if now - since >= self.pod_eviction_timeout:
+                self._evict_pods(name)
+
+    def _mark_unknown(self, node: api.Node):
+        fresh = deep_copy(node)
+        conds = list((fresh.status.conditions or []) if fresh.status else [])
+        for i, c in enumerate(conds):
+            if c.type == api.NODE_READY:
+                conds[i] = api.NodeCondition(
+                    type=api.NODE_READY, status=api.CONDITION_UNKNOWN,
+                    reason="NodeStatusUnknown",
+                    message="Kubelet stopped posting node status.",
+                    last_heartbeat_time=c.last_heartbeat_time,
+                    last_transition_time=now_iso())
+                break
+        if fresh.status is None:
+            fresh.status = api.NodeStatus()
+        fresh.status.conditions = conds
+        try:
+            self.client.update_status("nodes", fresh)
+        except ApiError:
+            pass
+
+    def _evict_pods(self, node_name: str):
+        pods = [p for p in self.pod_informer.store.list()
+                if p.spec and p.spec.node_name == node_name]
+        for pod in pods:
+            if not self.eviction_limiter.try_accept():
+                return  # rate limited: resume next tick
+            try:
+                self.client.delete("pods", pod.metadata.name,
+                                   pod.metadata.namespace)
+                log.info("evicted pod %s/%s from dead node %s",
+                         pod.metadata.namespace, pod.metadata.name, node_name)
+            except ApiError as e:
+                if not e.is_not_found:
+                    log.warning("evicting %s failed: %s", pod.metadata.name, e)
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.node_informer.run()
+        self.pod_informer.run()
+        self.node_informer.wait_for_sync()
+        self.pod_informer.wait_for_sync()
+        self._thread = threading.Thread(target=self._loop, name="node-controller",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.monitor_period):
+            try:
+                self.monitor_once()
+            except Exception:
+                log.exception("node monitor tick failed")
+
+    def stop(self):
+        self._stop.set()
+        self.node_informer.stop()
+        self.pod_informer.stop()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+def _heartbeat_of(node: api.Node) -> str:
+    for c in ((node.status.conditions or []) if node.status else []):
+        if c.type == api.NODE_READY:
+            return c.last_heartbeat_time or ""
+    return ""
+
+
+def _is_ready(node: api.Node) -> bool:
+    for c in ((node.status.conditions or []) if node.status else []):
+        if c.type == api.NODE_READY:
+            return c.status == api.CONDITION_TRUE
+    return False

@@ -1,0 +1,145 @@
+"""Replication controller: converge pod count to spec.replicas.
+
+Parity target: reference pkg/controller/replication/replication_controller.go
+(615 ln core) — watch RCs + pods; per RC key, diff matching active pods vs
+desired replicas; create from template / delete surplus. Pod churn enqueues
+the owning RC. The created-by annotation records provenance
+(kubernetes.io/created-by)."""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import List
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+
+log = logging.getLogger("rc-controller")
+
+
+class ReplicationManager(Controller):
+    name = "replication"
+
+    def __init__(self, client: RESTClient, workers: int = 2,
+                 burst_replicas: int = 500):
+        super().__init__(workers)
+        self.client = client
+        self.burst = burst_replicas
+        self.rc_informer = Informer(ListWatch(client, "replicationcontrollers"))
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.rc_informer.add_event_handler(
+            on_add=lambda rc: self.enqueue(_key(rc)),
+            on_update=lambda old, new: self.enqueue(_key(new)),
+            on_delete=lambda rc: self.enqueue(_key(rc)))
+        self.pod_informer.add_event_handler(
+            on_add=self._pod_changed,
+            on_update=lambda old, new: self._pod_changed(new),
+            on_delete=self._pod_changed)
+
+    def _pod_changed(self, pod: api.Pod):
+        for rc in self._controllers_for(pod):
+            self.enqueue(_key(rc))
+
+    def _controllers_for(self, pod: api.Pod) -> List[api.ReplicationController]:
+        out = []
+        lbls = (pod.metadata.labels or {})
+        for rc in self.rc_informer.store.list():
+            if rc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = rc.spec.selector if rc.spec else None
+            if sel and labelsel.selector_from_map(sel).matches(lbls):
+                out.append(rc)
+        return out
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        rc = self.rc_informer.store.get(key)
+        if rc is None:
+            return  # deleted; pods are left to the GC / cascade path
+        sel = labelsel.selector_from_map(rc.spec.selector)
+        pods = [p for p in self.pod_informer.store.list()
+                if p.metadata.namespace == ns
+                and p.metadata.deletion_timestamp is None
+                and _is_active(p)
+                and sel.matches(p.metadata.labels or {})]
+        diff = (rc.spec.replicas or 0) - len(pods)
+        if diff > 0:
+            for _ in range(min(diff, self.burst)):
+                self._create_pod(rc)
+        elif diff < 0:
+            # delete surplus: prefer unassigned, then unready (the reference
+            # sorts by activePods ranking)
+            victims = sorted(pods, key=_deletion_rank)[: min(-diff, self.burst)]
+            for p in victims:
+                try:
+                    self.client.delete("pods", p.metadata.name, ns)
+                except ApiError as e:
+                    if not e.is_not_found:
+                        raise
+        self._update_status(rc, pods)
+
+    def _create_pod(self, rc: api.ReplicationController):
+        tpl = rc.spec.template or api.PodTemplateSpec()
+        pod = api.Pod(
+            metadata=api.ObjectMeta(
+                generate_name=f"{rc.metadata.name}-",
+                namespace=rc.metadata.namespace,
+                labels=dict((tpl.metadata.labels if tpl.metadata else None) or {}),
+                annotations={api.ANN_CREATED_BY: json.dumps(
+                    {"kind": "ReplicationController",
+                     "namespace": rc.metadata.namespace,
+                     "name": rc.metadata.name, "uid": rc.metadata.uid})}),
+            spec=deep_copy(tpl.spec) if tpl.spec else api.PodSpec(
+                containers=[api.Container(name="c", image="pause")]))
+        self.client.create("pods", pod, rc.metadata.namespace)
+
+    def _update_status(self, rc: api.ReplicationController, pods: list):
+        desired_status = len(pods)
+        if rc.status and rc.status.replicas == desired_status:
+            return
+        fresh = deep_copy(rc)
+        fresh.status = api.ReplicationControllerStatus(replicas=desired_status)
+        try:
+            self.client.update_status("replicationcontrollers", fresh)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.rc_informer.run()
+        self.pod_informer.run()
+        self.rc_informer.wait_for_sync()
+        self.pod_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.rc_informer.stop()
+        self.pod_informer.stop()
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def _is_active(pod: api.Pod) -> bool:
+    phase = pod.status.phase if pod.status else ""
+    return phase not in (api.POD_SUCCEEDED, api.POD_FAILED)
+
+
+def _deletion_rank(pod: api.Pod):
+    """Unassigned first, then pending, then unready (activePods order)."""
+    assigned = bool(pod.spec and pod.spec.node_name)
+    phase = pod.status.phase if pod.status else ""
+    ready = any(c.type == api.POD_READY and c.status == api.CONDITION_TRUE
+                for c in ((pod.status.conditions or []) if pod.status else []))
+    return (assigned, phase == api.POD_RUNNING, ready)

@@ -1,0 +1,13 @@
+"""L7 node agent.
+
+Parity target: reference pkg/kubelet (52.4k LoC) — the load-bearing shape:
+syncLoop consuming pod-source updates (kubelet.go:2567), per-pod sync through
+a runtime interface (dockertools/rkt behind container.Runtime), local
+admission re-running GeneralPredicates (canAdmitPod), a status manager
+pushing PodStatus to the apiserver, node-status heartbeats, and PLEG-style
+runtime relisting. The hollow configuration (fake runtime + fake cadvisor) is
+the kubemark building block (cmd/kubemark/hollow-node.go:85-139).
+"""
+
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.runtime import FakeRuntime, PodRuntime

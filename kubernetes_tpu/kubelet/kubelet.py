@@ -1,0 +1,236 @@
+"""The kubelet: register node, heartbeat, sync assigned pods.
+
+Parity target: reference pkg/kubelet/kubelet.go — Run(:973) registers the
+node and starts the loops; syncLoopIteration (:2619) merges pod-source
+updates with periodic resyncs; syncPod (:1796) admits (GeneralPredicates,
+the node-side re-check), starts containers via the runtime, and the status
+manager (pkg/kubelet/status) pushes PodStatus. The PLEG relist
+(pleg/generic.go:180) is the periodic runtime-vs-desired diff in _resync.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from kubernetes_tpu.api import fields as fieldsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.record import EventRecorder
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.kubelet.runtime import FakeCadvisor, FakeRuntime, PodRuntime
+from kubernetes_tpu.scheduler.cache import NodeInfo
+from kubernetes_tpu.scheduler.predicates import PredicateFailure, general_predicates
+from kubernetes_tpu.utils.timeutil import now_iso
+
+log = logging.getLogger("kubelet")
+
+
+class Kubelet:
+    def __init__(self, client: RESTClient, node_name: str,
+                 runtime: Optional[PodRuntime] = None,
+                 cadvisor: Optional[FakeCadvisor] = None,
+                 heartbeat_period: float = 10.0,
+                 sync_period: float = 1.0,
+                 node_labels: Optional[Dict[str, str]] = None,
+                 pod_ip_base: str = "10.0"):
+        self.client = client
+        self.node_name = node_name
+        self.runtime = runtime or FakeRuntime()
+        self.cadvisor = cadvisor or FakeCadvisor()
+        self.heartbeat_period = heartbeat_period
+        self.sync_period = sync_period
+        self.node_labels = dict(node_labels or {})
+        self.node_labels.setdefault(api.LABEL_HOSTNAME, node_name)
+        self.recorder = EventRecorder(client, "kubelet", source_host=node_name)
+        self._pod_ip_base = pod_ip_base
+        self._ip_counter = 0
+        self._statuses: Dict[str, str] = {}  # key -> last phase written
+        self._stop = threading.Event()
+        self._threads = []
+        # pod source: apiserver watch filtered to me (config/apiserver.go:29)
+        self.pod_informer = Informer(ListWatch(
+            client, "pods",
+            field_selector=fieldsel.parse_field_selector(
+                f"spec.nodeName={node_name}")))
+        self.pod_informer.add_event_handler(
+            on_add=self._dispatch,
+            on_update=lambda old, new: self._dispatch(new),
+            on_delete=self._pod_deleted)
+
+    # --- node lifecycle ------------------------------------------------------
+
+    def register_node(self):
+        """Create (or adopt) our Node object (reference kubelet
+        registerWithApiserver)."""
+        resources = self.cadvisor.machine_resources()
+        node = api.Node(
+            metadata=api.ObjectMeta(name=self.node_name, labels=self.node_labels),
+            status=api.NodeStatus(
+                capacity=dict(resources), allocatable=dict(resources),
+                conditions=[_ready_condition()],
+                addresses=[api.NodeAddress(type="InternalIP",
+                                           address=self._node_ip())],
+                node_info=api.NodeSystemInfo(
+                    kubelet_version="kubernetes-tpu-0.1",
+                    container_runtime_version="fake://0.1")))
+        try:
+            self.client.create("nodes", node)
+        except ApiError as e:
+            if not e.code == 409:
+                raise
+
+    def heartbeat(self):
+        """Refresh the Ready condition (node status update loop)."""
+        try:
+            node = self.client.get("nodes", self.node_name)
+        except ApiError:
+            return
+        node.status = node.status or api.NodeStatus()
+        conds = [c for c in (node.status.conditions or [])
+                 if c.type != api.NODE_READY]
+        conds.append(_ready_condition())
+        node.status.conditions = conds
+        try:
+            self.client.update_status("nodes", node)
+        except ApiError:
+            pass
+
+    # --- pod sync ------------------------------------------------------------
+
+    def _dispatch(self, pod: api.Pod):
+        # runs inline on the informer dispatch thread: events for a pod are
+        # applied in order (the reference serializes via per-pod podWorkers;
+        # a thread-per-event here let a stale update resurrect a killed pod)
+        self._sync_pod(pod)
+
+    def _sync_pod(self, pod: api.Pod):
+        """syncPod: admit -> run -> report (kubelet.go:1796)."""
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        if pod.metadata.deletion_timestamp is not None:
+            self.runtime.kill_pod(key)
+            return
+        phase = pod.status.phase if pod.status else ""
+        if phase in (api.POD_SUCCEEDED, api.POD_FAILED):
+            return
+        if key not in self.runtime.running():
+            err = self._admit(pod)
+            if err is not None:
+                self._set_status(pod, api.POD_FAILED, reason="OutOfResources",
+                                 message=err)
+                self.recorder.event(pod, "Warning", "FailedAdmission", err)
+                return
+            self.runtime.sync_pod(pod)
+            self.recorder.event(pod, "Normal", "Started",
+                                f"Started pod {pod.metadata.name}")
+        self._set_status(pod, api.POD_RUNNING)
+
+    def _admit(self, pod: api.Pod) -> Optional[str]:
+        """Node-side re-check of GeneralPredicates (canAdmitPod; the kubelet
+        is the second enforcer, predicates.go:145-147)."""
+        try:
+            node = self.client.get("nodes", self.node_name)
+        except ApiError:
+            return None  # can't validate; accept (apiserver is authoritative)
+        ni = NodeInfo(node)
+        for rp in self.runtime.running().values():
+            ni.add_pod(rp.pod)
+        try:
+            general_predicates(pod, ni)
+        except PredicateFailure as e:
+            return str(e)
+        return None
+
+    def _set_status(self, pod: api.Pod, phase: str, reason: str = "",
+                    message: str = ""):
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        if self._statuses.get(key) == phase:
+            return
+        fresh = deep_copy(pod)
+        fresh.metadata.resource_version = ""  # unconditional status write
+        fresh.status = fresh.status or api.PodStatus()
+        fresh.status.phase = phase
+        fresh.status.reason = reason
+        fresh.status.message = message
+        fresh.status.host_ip = self._node_ip()
+        if phase == api.POD_RUNNING:
+            self._ip_counter += 1
+            fresh.status.pod_ip = fresh.status.pod_ip or (
+                f"{self._pod_ip_base}.{self._ip_counter // 255}."
+                f"{self._ip_counter % 255 + 1}")
+            fresh.status.start_time = fresh.status.start_time or now_iso()
+            conds = [c for c in (fresh.status.conditions or [])
+                     if c.type != api.POD_READY]
+            conds.append(api.PodCondition(type=api.POD_READY,
+                                          status=api.CONDITION_TRUE,
+                                          last_transition_time=now_iso()))
+            fresh.status.conditions = conds
+            running = self.runtime.running().get(key)
+            if running:
+                fresh.status.container_statuses = [
+                    api.ContainerStatus(
+                        name=c.name, ready=True, image=c.image,
+                        container_id=cid,
+                        state=api.ContainerState(
+                            running=api.ContainerStateRunning(started_at=now_iso())))
+                    for c, cid in zip(fresh.spec.containers or [],
+                                      running.container_ids)]
+        try:
+            self.client.update_status("pods", fresh)
+            self._statuses[key] = phase
+        except ApiError as e:
+            if not e.is_not_found:
+                log.warning("status update for %s failed: %s", key, e)
+
+    def _pod_deleted(self, pod: api.Pod):
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        self.runtime.kill_pod(key)
+        self._statuses.pop(key, None)
+
+    def _resync(self):
+        """PLEG-style relist: kill runtime pods no longer desired, re-assert
+        status for desired pods (pleg/generic.go:180 diffing)."""
+        desired = {k for k in (f"{p.metadata.namespace}/{p.metadata.name}"
+                               for p in self.pod_informer.store.list())}
+        for key in list(self.runtime.running()):
+            if key not in desired:
+                self.runtime.kill_pod(key)
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self, register: bool = True):
+        if register:
+            self.register_node()
+        self.pod_informer.run()
+        self.pod_informer.wait_for_sync()
+        for name, target, period in (
+                ("kubelet-heartbeat", self.heartbeat, self.heartbeat_period),
+                ("kubelet-resync", self._resync, self.sync_period)):
+            t = threading.Thread(target=self._periodic, args=(target, period),
+                                 name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _periodic(self, fn, period: float):
+        while not self._stop.wait(period):
+            try:
+                fn()
+            except Exception:
+                log.exception("periodic %s failed", fn.__name__)
+
+    def stop(self):
+        self._stop.set()
+        self.pod_informer.stop()
+
+    def _node_ip(self) -> str:
+        return "192.168.0.1"
+
+
+def _ready_condition() -> api.NodeCondition:
+    return api.NodeCondition(
+        type=api.NODE_READY, status=api.CONDITION_TRUE,
+        reason="KubeletReady", message="kubelet is posting ready status",
+        last_heartbeat_time=now_iso())

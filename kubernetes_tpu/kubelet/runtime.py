@@ -1,0 +1,81 @@
+"""Container runtime interface + the hollow (fake) implementation.
+
+Parity target: reference pkg/kubelet/container (Runtime iface) and
+pkg/kubelet/dockertools/fake_docker_client.go — the fake used by kubemark
+hollow nodes: containers "start" instantly (optionally with a simulated
+latency) and report Running until the pod is removed."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.utils.timeutil import now_iso
+
+
+@dataclass
+class RunningPod:
+    pod: api.Pod
+    started_at: str = field(default_factory=now_iso)
+    container_ids: List[str] = field(default_factory=list)
+
+
+class PodRuntime:
+    """What the kubelet needs from a runtime: run, kill, observe."""
+
+    def sync_pod(self, pod: api.Pod) -> None:
+        raise NotImplementedError
+
+    def kill_pod(self, pod_key: str) -> None:
+        raise NotImplementedError
+
+    def running(self) -> Dict[str, RunningPod]:
+        raise NotImplementedError
+
+
+class FakeRuntime(PodRuntime):
+    """Instant-start runtime (EnableSleep mimics the fake docker client's
+    latency knob, hollow-node.go:118)."""
+
+    def __init__(self, start_latency: float = 0.0):
+        self._lock = threading.Lock()
+        self._pods: Dict[str, RunningPod] = {}
+        self.start_latency = start_latency
+        self._counter = 0
+
+    def sync_pod(self, pod: api.Pod) -> None:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        with self._lock:
+            if key in self._pods:
+                return
+        if self.start_latency:
+            time.sleep(self.start_latency)
+        with self._lock:
+            self._counter += 1
+            self._pods[key] = RunningPod(
+                pod=pod,
+                container_ids=[f"fake://{self._counter:08x}-{c.name}"
+                               for c in (pod.spec.containers or [])])
+
+    def kill_pod(self, pod_key: str) -> None:
+        with self._lock:
+            self._pods.pop(pod_key, None)
+
+    def running(self) -> Dict[str, RunningPod]:
+        with self._lock:
+            return dict(self._pods)
+
+
+class FakeCadvisor:
+    """Machine info provider (reference pkg/kubelet/cadvisor/testing fake)."""
+
+    def __init__(self, cpu: str = "4", memory: str = "32Gi", pods: str = "110"):
+        self.cpu = cpu
+        self.memory = memory
+        self.pods = pods
+
+    def machine_resources(self) -> Dict[str, str]:
+        return {"cpu": self.cpu, "memory": self.memory, "pods": self.pods}

@@ -1,0 +1,9 @@
+"""Scale harness: hollow nodes.
+
+Parity target: reference cmd/kubemark/hollow-node.go + pkg/kubemark —
+production kubelet/proxy code wired to fakes (docker/cadvisor/iptables) so
+thousands of "nodes" run on one machine; the cluster under test is real
+(apiserver, scheduler, controllers), only the container runtime is hollow.
+"""
+
+from kubernetes_tpu.kubemark.hollow import HollowCluster, HollowNode

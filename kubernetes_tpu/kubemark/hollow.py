@@ -1,0 +1,130 @@
+"""Hollow node + hollow cluster orchestration.
+
+A HollowNode is the real Kubelet with FakeRuntime/FakeCadvisor
+(hollow_kubelet.go:35) and optionally a Proxier with FakeIptables
+(hollow_proxy.go:35). HollowCluster boots N of them against one API server,
+for scheduler_perf/density-style scale runs (test/kubemark/start-kubemark.sh
+semantics, in-process).
+
+Efficiency note: at N=1000s, one informer per hollow kubelet would open
+1000s of watch streams; like kubemark's shared-client setup, HollowCluster
+can multiplex all hollow kubelets over a single pod informer
+(shared_informer=True) while keeping per-node state separate.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api import fields as fieldsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.kubelet.runtime import FakeCadvisor
+from kubernetes_tpu.proxy import FakeIptables, Proxier
+
+log = logging.getLogger("kubemark")
+
+
+class HollowNode:
+    def __init__(self, client: RESTClient, name: str, run_proxy: bool = False,
+                 cpu: str = "4", memory: str = "32Gi", pods: str = "110",
+                 labels: Optional[Dict[str, str]] = None):
+        self.kubelet = Kubelet(
+            client, name, runtime=FakeRuntime(),
+            cadvisor=FakeCadvisor(cpu=cpu, memory=memory, pods=pods),
+            node_labels=labels)
+        self.proxy = Proxier(client, FakeIptables(), node_name=name) if run_proxy else None
+
+    def start(self):
+        self.kubelet.start()
+        if self.proxy:
+            self.proxy.start()
+        return self
+
+    def stop(self):
+        self.kubelet.stop()
+        if self.proxy:
+            self.proxy.stop()
+
+
+class HollowCluster:
+    """N hollow nodes sharing one client + one pod informer."""
+
+    def __init__(self, client: RESTClient, num_nodes: int,
+                 zone_count: int = 3, cpu: str = "4", memory: str = "32Gi",
+                 pods: str = "110"):
+        self.client = client
+        self.nodes: List[Kubelet] = []
+        self._shared_informer: Optional[Informer] = None
+        self._num = num_nodes
+        self._zone_count = zone_count
+        self._resources = dict(cpu=cpu, memory=memory, pods=pods)
+        self._kubelets: Dict[str, Kubelet] = {}
+        self._stop_evt = __import__("threading").Event()
+        self._hb_thread = None
+
+    def start(self, heartbeat_period: float = 30.0):
+        # register all nodes first (bulk), then one shared informer feeds
+        # every hollow kubelet's runtime, and one shared thread heartbeats
+        # all of them (per-node loops don't scale to thousands in-process)
+        import threading
+
+        for i in range(self._num):
+            name = f"hollow-{i:05d}"
+            labels = {api.LABEL_HOSTNAME: name,
+                      api.LABEL_ZONE: f"zone-{i % self._zone_count}"}
+            kl = Kubelet(self.client, name, runtime=FakeRuntime(),
+                         cadvisor=FakeCadvisor(**self._resources),
+                         heartbeat_period=heartbeat_period,
+                         node_labels=labels)
+            kl.register_node()
+            self._kubelets[name] = kl
+            self.nodes.append(kl)
+
+        inf = Informer(ListWatch(
+            self.client, "pods",
+            field_selector=fieldsel.parse_field_selector("spec.nodeName!=")))
+
+        def route(pod: api.Pod):
+            kl = self._kubelets.get(pod.spec.node_name if pod.spec else "")
+            if kl is not None:
+                kl._dispatch(pod)
+
+        def route_delete(pod: api.Pod):
+            kl = self._kubelets.get(pod.spec.node_name if pod.spec else "")
+            if kl is not None:
+                kl._pod_deleted(pod)
+
+        inf.add_event_handler(on_add=route,
+                              on_update=lambda o, n: route(n),
+                              on_delete=route_delete)
+        inf.run()
+        inf.wait_for_sync(30)
+        self._shared_informer = inf
+
+        def hb_loop():
+            while not self._stop_evt.wait(heartbeat_period):
+                desired_by_node: Dict[str, set] = {}
+                for p in inf.store.list():
+                    desired_by_node.setdefault(p.spec.node_name, set()).add(
+                        f"{p.metadata.namespace}/{p.metadata.name}")
+                for name, kl in self._kubelets.items():
+                    kl.heartbeat()
+                    # shared-resync: reap runtime pods no longer desired
+                    desired = desired_by_node.get(name, set())
+                    for key in list(kl.runtime.running()):
+                        if key not in desired:
+                            kl.runtime.kill_pod(key)
+
+        self._hb_thread = threading.Thread(target=hb_loop,
+                                           name="hollow-heartbeat", daemon=True)
+        self._hb_thread.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._shared_informer:
+            self._shared_informer.stop()

@@ -1,0 +1,9 @@
+"""L8 service dataplane.
+
+Parity target: reference pkg/proxy/iptables (proxier.go) — the iptables-mode
+proxier: consume service + endpoints updates, compile the full NAT ruleset,
+apply it atomically in one restore call (proxier.go:640 syncProxyRules with
+iptables-restore).
+"""
+
+from kubernetes_tpu.proxy.proxier import FakeIptables, Proxier

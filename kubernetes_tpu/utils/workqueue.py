@@ -1,0 +1,162 @@
+"""Work queues for controllers.
+
+Parity target: reference pkg/util/workqueue — the deduplicating Type
+(queue.go: an item re-added while processing is re-queued, not duplicated),
+DelayingQueue (delaying_queue.go), RateLimitingQueue
+(rate_limitting_queue.go with the default exponential per-item +
+overall-token-bucket limiter, default_rate_limiters.go), and
+Parallelize (parallelizer.go:17-48) — the 16-way helper the scheduler's
+filter stage used, re-expressed on-device in ops/ but kept here for host-side
+controller fan-out.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, Optional
+
+from kubernetes_tpu.utils.flowcontrol import Backoff
+
+
+class WorkQueue:
+    """Deduplicating FIFO of hashable items with in-flight tracking:
+    `add` while an item is processing marks it dirty for reprocessing after
+    `done` (reference workqueue.Type semantics)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue: list = []
+        self._queued: set = set()
+        self._processing: set = set()
+        self._dirty: set = set()
+        self._shutdown = False
+
+    def add(self, item):
+        with self._cond:
+            if self._shutdown or item in self._queued:
+                return
+            if item in self._processing:
+                self._dirty.add(item)
+                return
+            self._queued.add(item)
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None):
+        """Block for the next item; None on shutdown/timeout. Caller must
+        call done(item)."""
+        with self._cond:
+            while not self._queue and not self._shutdown:
+                if not self._cond.wait(timeout=timeout):
+                    return None
+            if not self._queue:
+                return None
+            item = self._queue.pop(0)
+            self._queued.discard(item)
+            self._processing.add(item)
+            return item
+
+    def done(self, item):
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                self._queued.add(item)
+                self._queue.append(item)
+                self._cond.notify()
+
+    def shutdown(self):
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self):
+        with self._cond:
+            return len(self._queue)
+
+
+class DelayingQueue(WorkQueue):
+    """add_after(item, delay): deliver after delay via a waiting thread and
+    a heap (reference delaying_queue.go)."""
+
+    def __init__(self, clock=time.monotonic):
+        super().__init__()
+        self._clock = clock
+        self._heap: list = []
+        self._heap_cond = threading.Condition()
+        self._seq = 0
+        self._waiter = threading.Thread(target=self._wait_loop,
+                                        name="delaying-queue", daemon=True)
+        self._waiter_started = False
+
+    def add_after(self, item, delay: float):
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._heap_cond:
+            if not self._waiter_started:
+                self._waiter.start()
+                self._waiter_started = True
+            self._seq += 1
+            heapq.heappush(self._heap, (self._clock() + delay, self._seq, item))
+            self._heap_cond.notify()
+
+    def _wait_loop(self):
+        while True:
+            with self._heap_cond:
+                while not self._heap:
+                    self._heap_cond.wait()
+                at, _, item = self._heap[0]
+                now = self._clock()
+                if at > now:
+                    self._heap_cond.wait(timeout=at - now)
+                    continue
+                heapq.heappop(self._heap)
+            self.add(item)
+
+
+class RateLimitingQueue(DelayingQueue):
+    """add_rate_limited(item) delays by a per-item exponential backoff;
+    forget(item) resets it (reference rate_limitting_queue.go with the
+    ItemExponentialFailureRateLimiter)."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0,
+                 clock=time.monotonic):
+        super().__init__(clock=clock)
+        self._backoff = Backoff(initial=base_delay, maximum=max_delay, clock=clock)
+
+    def add_rate_limited(self, item):
+        self.add_after(item, self._backoff.next(_key(item)))
+
+    def forget(self, item):
+        self._backoff.reset(_key(item))
+
+
+def _key(item) -> str:
+    return str(item)
+
+
+def parallelize(workers: int, pieces: int, do_piece: Callable[[int], None]):
+    """Run do_piece(0..pieces-1) on `workers` threads
+    (reference parallelizer.go:29)."""
+    if pieces <= 0:
+        return
+    it = iter(range(pieces))
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = next(it, None)
+            if i is None:
+                return
+            do_piece(i)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(min(workers, pieces))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()

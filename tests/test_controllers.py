@@ -1,0 +1,289 @@
+"""Controllers + workqueue + leader election against a live in-proc cluster."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
+from kubernetes_tpu.controllers.endpoints_controller import EndpointsController
+from kubernetes_tpu.controllers.node_controller import NodeController
+from kubernetes_tpu.controllers.replication_controller import ReplicationManager
+from kubernetes_tpu.utils.workqueue import (
+    DelayingQueue, RateLimitingQueue, WorkQueue, parallelize,
+)
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=2000, burst=2000)
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:
+            pass
+        time.sleep(0.03)
+    raise AssertionError("condition not met")
+
+
+class TestWorkQueue:
+    def test_dedup(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("a")
+        q.add("b")
+        assert len(q) == 2
+
+    def test_dirty_requeue_while_processing(self):
+        q = WorkQueue()
+        q.add("a")
+        item = q.get()
+        q.add("a")          # while processing: marked dirty, not queued
+        assert len(q) == 0
+        q.done(item)        # now requeued
+        assert len(q) == 1
+
+    def test_delaying(self):
+        q = DelayingQueue()
+        q.add_after("x", 0.1)
+        assert q.get(timeout=0.02) is None
+        assert q.get(timeout=1.0) == "x"
+
+    def test_rate_limited_backoff_grows(self):
+        q = RateLimitingQueue(base_delay=0.01, max_delay=1.0)
+        t0 = time.monotonic()
+        q.add_rate_limited("x")
+        assert q.get(timeout=2.0) == "x"
+        q.done("x")
+        q.add_rate_limited("x")  # second failure: 2x delay
+        assert q.get(timeout=2.0) == "x"
+        q.forget("x")
+
+    def test_parallelize(self):
+        out = []
+        import threading
+        lock = threading.Lock()
+
+        def piece(i):
+            with lock:
+                out.append(i)
+
+        parallelize(4, 20, piece)
+        assert sorted(out) == list(range(20))
+
+
+def mk_rc(name, replicas, labels):
+    return api.ReplicationController(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.ReplicationControllerSpec(
+            replicas=replicas, selector=dict(labels),
+            template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels=dict(labels)),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="pause",
+                    resources=api.ResourceRequirements(
+                        requests={"cpu": "100m"}))]))))
+
+
+class TestReplicationController:
+    def test_scales_up_and_down(self, client):
+        rm = ReplicationManager(client)
+        rm.start()
+        try:
+            client.create("replicationcontrollers", mk_rc("web", 3, {"app": "web"}))
+            _wait(lambda: len(client.list("pods", "default")[0]) == 3)
+            pods, _ = client.list("pods", "default")
+            assert all(p.metadata.name.startswith("web-") for p in pods)
+            assert all((p.metadata.labels or {}).get("app") == "web" for p in pods)
+            # scale down
+            rc = client.get("replicationcontrollers", "web", "default")
+            rc.spec.replicas = 1
+            client.update("replicationcontrollers", rc)
+            _wait(lambda: len(client.list("pods", "default")[0]) == 1)
+            # status reflects observed count
+            _wait(lambda: client.get("replicationcontrollers", "web",
+                                     "default").status.replicas == 1)
+        finally:
+            rm.stop()
+
+    def test_replaces_deleted_pod(self, client):
+        rm = ReplicationManager(client)
+        rm.start()
+        try:
+            client.create("replicationcontrollers", mk_rc("r", 2, {"app": "r"}))
+            _wait(lambda: len(client.list("pods", "default")[0]) == 2)
+            victim = client.list("pods", "default")[0][0]
+            client.delete("pods", victim.metadata.name, "default")
+            _wait(lambda: len(client.list("pods", "default")[0]) == 2)
+        finally:
+            rm.stop()
+
+
+class TestEndpointsController:
+    def test_builds_endpoints_from_ready_pods(self, client):
+        ec = EndpointsController(client)
+        ec.start()
+        try:
+            client.create("services", api.Service(
+                metadata=api.ObjectMeta(name="svc", namespace="default"),
+                spec=api.ServiceSpec(selector={"app": "web"},
+                                     ports=[api.ServicePort(port=80, target_port=8080)])))
+            pod = api.Pod(
+                metadata=api.ObjectMeta(name="w1", namespace="default",
+                                        labels={"app": "web"}),
+                spec=api.PodSpec(node_name="n1", containers=[
+                    api.Container(name="c", image="i")]),
+                status=api.PodStatus(
+                    phase="Running", pod_ip="10.1.0.5",
+                    conditions=[api.PodCondition(type="Ready", status="True")]))
+            # create via registry (status is server-managed on normal create)
+            client.create("pods", api.Pod(
+                metadata=pod.metadata, spec=pod.spec))
+            got = client.get("pods", "w1", "default")
+            got.status = pod.status
+            client.update_status("pods", got)
+            _wait(lambda: client.get("endpoints", "svc", "default").subsets)
+            ep = client.get("endpoints", "svc", "default")
+            assert ep.subsets[0].addresses[0].ip == "10.1.0.5"
+            assert ep.subsets[0].ports[0].port == 8080
+            # pod goes unready -> moves to notReadyAddresses
+            got = client.get("pods", "w1", "default")
+            got.status.conditions = [api.PodCondition(type="Ready", status="False")]
+            client.update_status("pods", got)
+            _wait(lambda: (client.get("endpoints", "svc", "default")
+                           .subsets[0].not_ready_addresses))
+        finally:
+            ec.stop()
+
+
+class TestNodeController:
+    def test_marks_stale_node_unknown_and_evicts(self, client):
+        now = [1000.0]
+        nc = NodeController(client, monitor_period=999, grace_period=40,
+                            pod_eviction_timeout=60, eviction_qps=1000,
+                            clock=lambda: now[0])
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="n1"),
+            status=api.NodeStatus(
+                capacity={"cpu": "4", "pods": "10"},
+                conditions=[api.NodeCondition(
+                    type="Ready", status="True",
+                    last_heartbeat_time="t0")])))
+        client.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="p1", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(name="c", image="i")])))
+        client.bind(api.Binding(
+            metadata=api.ObjectMeta(name="p1", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n1")), "default")
+        nc.node_informer.run()
+        nc.pod_informer.run()
+        nc.node_informer.wait_for_sync()
+        nc.pod_informer.wait_for_sync()
+        try:
+            nc.monitor_once()           # baseline heartbeat observed
+            now[0] += 50                # > grace period, no new heartbeat
+            nc.monitor_once()
+            _wait(lambda: any(
+                c.type == "Ready" and c.status == "Unknown"
+                for c in client.get("nodes", "n1").status.conditions))
+            now[0] += 70                # > eviction timeout
+            nc.monitor_once()
+            _wait(lambda: not client.list(
+                "pods", "default",
+                field_selector=None)[0])
+        finally:
+            nc.node_informer.stop()
+            nc.pod_informer.stop()
+
+    def test_fresh_heartbeat_resets(self, client):
+        now = [0.0]
+        nc = NodeController(client, clock=lambda: now[0])
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="n1"),
+            status=api.NodeStatus(conditions=[api.NodeCondition(
+                type="Ready", status="True", last_heartbeat_time="h1")])))
+        nc.node_informer.run()
+        nc.node_informer.wait_for_sync()
+        nc.pod_informer.run()
+        nc.pod_informer.wait_for_sync()
+        try:
+            nc.monitor_once()
+            now[0] += 50
+            n = client.get("nodes", "n1")
+            n.status.conditions[0].last_heartbeat_time = "h2"
+            client.update_status("nodes", n)
+            _wait(lambda: nc.node_informer.store.get("n1")
+                  .status.conditions[0].last_heartbeat_time == "h2")
+            nc.monitor_once()
+            assert "n1" not in nc._not_ready_since
+        finally:
+            nc.node_informer.stop()
+            nc.pod_informer.stop()
+
+
+class TestNamespaceController:
+    def test_cascade_delete(self, client):
+        from kubernetes_tpu.controllers.namespace_controller import NamespaceController
+        nc = NamespaceController(client).start()
+        try:
+            client.create("namespaces", api.Namespace(
+                metadata=api.ObjectMeta(name="doomed")))
+            client.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name="p", namespace="doomed"),
+                spec=api.PodSpec(containers=[api.Container(name="c", image="i")])))
+            ns = client.get("namespaces", "doomed")
+            ns.status = api.NamespaceStatus(phase="Terminating")
+            # regression: /api/v1/namespaces/{name}/status must route to the
+            # namespaces status subresource, not parse as ns+resource
+            client.update_status("namespaces", ns)
+            _wait(lambda: _gone(client, "namespaces", "doomed"))
+            assert not client.list("pods", "doomed")[0]
+        finally:
+            nc.stop()
+
+
+def _gone(client, resource, name):
+    try:
+        client.get(resource, name)
+        return False
+    except Exception:
+        return True
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self, client):
+        started = []
+
+        def make(identity):
+            return LeaderElector(
+                client,
+                LeaderElectionConfig(lock_name="lock", identity=identity,
+                                     lease_duration=0.6, renew_deadline=0.4,
+                                     retry_period=0.1),
+                on_started_leading=lambda i=identity: started.append(i))
+
+        a, b = make("a"), make("b")
+        a.run()
+        _wait(lambda: a.is_leader)
+        b.run()
+        time.sleep(0.4)
+        assert not b.is_leader          # lease held by a
+        assert started == ["a"]
+        a.stop()                        # stops renewing
+        _wait(lambda: b.is_leader, timeout=5)
+        assert started == ["a", "b"]
+        b.stop()

@@ -1,0 +1,209 @@
+"""Kubelet (hollow), proxier, and the full-stack e2e: apiserver + scheduler +
+RC controller + endpoints controller + hollow kubelet + proxy — a pod goes
+RC -> scheduled -> running -> endpoints -> NAT rules end to end (the
+reference's density-style smoke at miniature scale)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.controllers.endpoints_controller import EndpointsController
+from kubernetes_tpu.controllers.replication_controller import ReplicationManager
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.proxy import FakeIptables, Proxier
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=2000, burst=2000)
+
+
+def _wait(cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:
+            pass
+        time.sleep(0.03)
+    raise AssertionError("condition not met")
+
+
+def mk_pod(name, node="", cpu="100m"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(node_name=node, containers=[api.Container(
+            name="c", image="pause",
+            resources=api.ResourceRequirements(requests={"cpu": cpu}))]))
+
+
+class TestKubelet:
+    def test_registers_node_with_capacity(self, client):
+        kl = Kubelet(client, "node-a")
+        kl.start()
+        try:
+            node = client.get("nodes", "node-a")
+            assert node.status.capacity["cpu"] == "4"
+            conds = {c.type: c.status for c in node.status.conditions}
+            assert conds["Ready"] == "True"
+        finally:
+            kl.stop()
+
+    def test_runs_assigned_pod_and_reports_status(self, client):
+        kl = Kubelet(client, "node-a")
+        kl.start()
+        try:
+            client.create("pods", mk_pod("p1"))
+            client.bind(api.Binding(
+                metadata=api.ObjectMeta(name="p1", namespace="default"),
+                target=api.ObjectReference(kind="Node", name="node-a")), "default")
+            _wait(lambda: client.get("pods", "p1", "default").status.phase == "Running")
+            pod = client.get("pods", "p1", "default")
+            assert pod.status.pod_ip
+            assert pod.status.container_statuses[0].state.running
+            conds = {c.type: c.status for c in pod.status.conditions}
+            assert conds["Ready"] == "True"
+        finally:
+            kl.stop()
+
+    def test_admission_rejects_overcommit(self, client):
+        """The kubelet re-runs GeneralPredicates locally (the second
+        enforcer) — direct-bound pods that don't fit are Failed."""
+        kl = Kubelet(client, "node-a")
+        kl.start()
+        try:
+            client.create("pods", mk_pod("fat", cpu="64"))
+            client.bind(api.Binding(
+                metadata=api.ObjectMeta(name="fat", namespace="default"),
+                target=api.ObjectReference(kind="Node", name="node-a")), "default")
+            _wait(lambda: client.get("pods", "fat", "default").status.phase == "Failed")
+            pod = client.get("pods", "fat", "default")
+            assert pod.status.reason == "OutOfResources"
+        finally:
+            kl.stop()
+
+    def test_deletion_kills_runtime_pod(self, client):
+        rt = FakeRuntime()
+        kl = Kubelet(client, "node-a", runtime=rt)
+        kl.start()
+        try:
+            client.create("pods", mk_pod("p1"))
+            client.bind(api.Binding(
+                metadata=api.ObjectMeta(name="p1", namespace="default"),
+                target=api.ObjectReference(kind="Node", name="node-a")), "default")
+            _wait(lambda: "default/p1" in rt.running())
+            client.delete("pods", "p1", "default")
+            _wait(lambda: "default/p1" not in rt.running())
+        finally:
+            kl.stop()
+
+
+class TestProxier:
+    def test_compiles_nat_rules(self, client):
+        ipt = FakeIptables()
+        px = Proxier(client, ipt)
+        client.create("services", api.Service(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.ServiceSpec(cluster_ip="10.96.0.10", selector={"app": "web"},
+                                 ports=[api.ServicePort(name="http", port=80)])))
+        client.create("endpoints", api.Endpoints(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="10.1.0.5"),
+                           api.EndpointAddress(ip="10.1.0.6")],
+                ports=[api.EndpointPort(name="http", port=8080)])]))
+        px.start()
+        try:
+            rules = ipt.current
+            assert "-d 10.96.0.10/32" in rules and "--dport 80" in rules
+            assert "10.1.0.5:8080" in rules and "10.1.0.6:8080" in rules
+            assert "--probability 0.50000" in rules  # 2-way balance
+            # endpoint removal resyncs
+            client.update("endpoints", api.Endpoints(
+                metadata=api.ObjectMeta(
+                    name="web", namespace="default",
+                    resource_version=client.get("endpoints", "web", "default"
+                                                ).metadata.resource_version),
+                subsets=[api.EndpointSubset(
+                    addresses=[api.EndpointAddress(ip="10.1.0.5")],
+                    ports=[api.EndpointPort(name="http", port=8080)])]))
+            _wait(lambda: "10.1.0.6:8080" not in ipt.current)
+            assert "10.1.0.5:8080" in ipt.current
+        finally:
+            px.stop()
+
+
+class TestFullStack:
+    def test_rc_to_nat_rules_end_to_end(self, client):
+        """RC -> scheduler -> hollow kubelet -> endpoints -> proxy."""
+        components = []
+        try:
+            for name in ("node-1", "node-2"):
+                kl = Kubelet(client, name)
+                kl.start()
+                components.append(kl)
+            factory = ConfigFactory(client)
+            factory.run()
+            sched = factory.create_from_provider().run()
+            components.extend([sched, factory])
+            rm = ReplicationManager(client)
+            rm.start()
+            components.append(rm)
+            ec = EndpointsController(client)
+            ec.start()
+            components.append(ec)
+            ipt = FakeIptables()
+            px = Proxier(client, ipt)
+            px.start()
+            components.append(px)
+
+            client.create("services", api.Service(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ServiceSpec(cluster_ip="10.96.0.1",
+                                     selector={"app": "web"},
+                                     ports=[api.ServicePort(name="http", port=80,
+                                                            target_port=8080)])))
+            client.create("replicationcontrollers", api.ReplicationController(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ReplicationControllerSpec(
+                    replicas=3, selector={"app": "web"},
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"app": "web"}),
+                        spec=api.PodSpec(containers=[api.Container(
+                            name="c", image="pause",
+                            resources=api.ResourceRequirements(
+                                requests={"cpu": "100m"}))])))))
+
+            # 3 pods running across both nodes
+            def all_running():
+                pods, _ = client.list("pods", "default")
+                return (len(pods) == 3
+                        and all(p.status and p.status.phase == "Running"
+                                for p in pods)
+                        and all(p.spec.node_name for p in pods))
+
+            _wait(all_running, timeout=30)
+            pods, _ = client.list("pods", "default")
+            assert {p.spec.node_name for p in pods} == {"node-1", "node-2"}
+
+            # endpoints have 3 ready addresses; proxy compiled DNAT for each
+            _wait(lambda: len(client.get("endpoints", "web", "default")
+                              .subsets[0].addresses or []) == 3, timeout=30)
+            ips = [a.ip for a in client.get("endpoints", "web", "default")
+                   .subsets[0].addresses]
+            _wait(lambda: all(f"{ip}:8080" in ipt.current for ip in ips))
+        finally:
+            for c in reversed(components):
+                c.stop()

@@ -1,0 +1,70 @@
+"""Kubemark-style scale smoke: N hollow nodes on one shared informer, a
+pending-pod wave pushed through the real scheduler, everything Running.
+(The reference's scheduler_perf + kubemark pattern at CI-friendly scale;
+bench.py covers the 30k/5k tensor path on hardware.)"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+
+@pytest.mark.slow
+def test_hollow_cluster_schedules_wave():
+    server = APIServer().start()
+    client = RESTClient.for_server(server, qps=5000, burst=5000)
+    hollow = None
+    sched = factory = None
+    try:
+        hollow = HollowCluster(client, num_nodes=30).start()
+        nodes, _ = client.list("nodes")
+        assert len(nodes) == 30
+
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_from_provider().run()
+
+        n_pods = 120
+        t0 = time.monotonic()
+        for i in range(n_pods):
+            client.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name=f"load-{i:04d}", namespace="default",
+                                        labels={"app": "load"}),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="pause",
+                    resources=api.ResourceRequirements(
+                        requests={"cpu": "100m", "memory": "200Mi"}))])))
+
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            pods, _ = client.list("pods", "default")
+            running = [p for p in pods
+                       if p.status and p.status.phase == "Running"]
+            if len(running) == n_pods:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"only {len(running)}/{n_pods} running within deadline")
+        elapsed = time.monotonic() - t0
+
+        # every pod placed on a hollow node, spread across many nodes
+        by_node = {}
+        for p in pods:
+            by_node.setdefault(p.spec.node_name, 0)
+            by_node[p.spec.node_name] += 1
+        assert all(n.startswith("hollow-") for n in by_node)
+        assert len(by_node) >= 25
+        assert max(by_node.values()) <= 110
+        print(f"\nkubemark smoke: {n_pods} pods on 30 hollow nodes in "
+              f"{elapsed:.1f}s ({n_pods / elapsed:.0f} pods/s e2e)")
+    finally:
+        for c in (sched, factory, hollow):
+            if c is not None:
+                c.stop()
+        server.stop()
