@@ -10,6 +10,7 @@ Crash-only: a leader that stops renewing is superseded after lease_duration.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from dataclasses import dataclass
@@ -19,6 +20,8 @@ from kubernetes_tpu.api import types as api
 from kubernetes_tpu.client.rest import ApiError, RESTClient
 
 LEADER_ANNOTATION = "control-plane.alpha.kubernetes.io/leader"
+
+log = logging.getLogger("leaderelection")
 
 
 @dataclass
@@ -111,30 +114,42 @@ class LeaderElector:
         return self
 
     def _loop(self):
-        # acquire
+        # The reference exits the process on lost leadership and relies on a
+        # supervisor restart; with no supervisor here, losing the lease
+        # re-enters the acquire loop so a healed candidate can lead again —
+        # but only when on_stopped is provided, since that callback is the
+        # contract for tearing down the previous term's work (re-acquiring
+        # without it would run two copies of the leader workload in-process).
         while not self._stop.is_set():
-            if self.try_acquire_or_renew():
-                break
-            self._stop.wait(self.cfg.retry_period)
-        if self._stop.is_set():
-            return
-        self._is_leader = True
-        threading.Thread(target=self.on_started, daemon=True).start()
-        # renew
-        while not self._stop.is_set():
-            deadline = self._clock() + self.cfg.renew_deadline
-            renewed = False
-            while self._clock() < deadline and not self._stop.is_set():
+            # acquire
+            while not self._stop.is_set():
                 if self.try_acquire_or_renew():
-                    renewed = True
                     break
                 self._stop.wait(self.cfg.retry_period)
-            if not renewed:
-                break
-            self._stop.wait(self.cfg.retry_period)
-        self._is_leader = False
-        if self.on_stopped:
-            self.on_stopped()
+            if self._stop.is_set():
+                return
+            self._is_leader = True
+            threading.Thread(target=self.on_started, daemon=True).start()
+            # renew
+            while not self._stop.is_set():
+                deadline = self._clock() + self.cfg.renew_deadline
+                renewed = False
+                while self._clock() < deadline and not self._stop.is_set():
+                    if self.try_acquire_or_renew():
+                        renewed = True
+                        break
+                    self._stop.wait(self.cfg.retry_period)
+                if not renewed:
+                    break
+                self._stop.wait(self.cfg.retry_period)
+            self._is_leader = False
+            if not self.on_stopped:
+                return  # one term max: nothing can stop the started work
+            try:
+                self.on_stopped()
+            except Exception:
+                log.exception("on_stopped_leading callback failed; "
+                              "continuing to re-acquire")
 
     def stop(self):
         self._stop.set()

@@ -2,8 +2,8 @@
 
 Parity target: the shared shape of every reference controller
 (pkg/controller/*/: informer handlers enqueue keys, N workers pop and sync,
-errors re-enqueue rate-limited; controller_utils.go expectations are replaced
-by idempotent syncs against live reads)."""
+errors re-enqueue rate-limited; see expectations.py for the
+controller_utils.go expectations pattern used by pod-creating controllers)."""
 
 from __future__ import annotations
 

@@ -97,7 +97,10 @@ class EndpointsController(Controller):
         except ApiError as e:
             if e.is_not_found:
                 self.client.create("endpoints", desired, ns)
-            elif not e.is_conflict:
+            else:
+                # includes conflict: a concurrent writer bumped the version
+                # between our get and update — raise so the worker requeues
+                # and the next sync recomputes from a fresh read
                 raise
 
     def start(self):

@@ -1,0 +1,66 @@
+"""Controller expectations: suppress re-sync until our own writes are seen.
+
+Parity target: reference pkg/controller/controller_utils.go (ControllerExpectations,
+ExpectationsTimeout 5m) — a controller that just created/deleted N pods must not
+act again for the same key until the informer cache has delivered those N events
+(or the expectation expired), otherwise cache lag causes double-creates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+EXPECTATIONS_TIMEOUT = 5 * 60.0
+
+
+class ControllerExpectations:
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> [adds_pending, dels_pending, set_time]
+        self._exp: Dict[str, list] = {}
+
+    def satisfied_expectations(self, key: str) -> bool:
+        with self._lock:
+            e = self._exp.get(key)
+            if e is None:
+                return True
+            adds, dels, t = e
+            if adds <= 0 and dels <= 0:
+                return True
+            if self._clock() - t > EXPECTATIONS_TIMEOUT:
+                return True  # expired: self-heal by allowing a fresh sync
+            return False
+
+    def expect_creations(self, key: str, n: int) -> None:
+        self._set(key, adds=n, dels=0)
+
+    def expect_deletions(self, key: str, n: int) -> None:
+        self._set(key, adds=0, dels=n)
+
+    def _set(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            self._exp[key] = [adds, dels, self._clock()]
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, 0)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, 1)
+
+    def _lower(self, key: str, idx: int) -> None:
+        with self._lock:
+            e = self._exp.get(key)
+            if e is not None and e[idx] > 0:
+                e[idx] -= 1
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._exp.pop(key, None)
+
+    def get(self, key: str) -> Optional[Tuple[int, int]]:
+        with self._lock:
+            e = self._exp.get(key)
+            return (e[0], e[1]) if e else None

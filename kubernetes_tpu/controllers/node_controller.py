@@ -41,28 +41,63 @@ class NodeController:
         self._last_heartbeat: Dict[str, float] = {}
         self._last_seen: Dict[str, float] = {}
         self._not_ready_since: Dict[str, float] = {}
+        self._deleted_nodes: Dict[str, float] = {}  # name -> deletion time
+        self._deleted_lock = threading.Lock()
+        self.node_informer.add_event_handler(on_delete=self._node_deleted)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _node_deleted(self, node: api.Node):
+        """A deleted Node leaves its bound pods orphaned — queue them for
+        eviction on the next monitor tick (reference evicts on node deletion)
+        and drop the per-node tracking state."""
+        name = node.metadata.name
+        with self._deleted_lock:
+            self._deleted_nodes[name] = self._clock()
+            self._last_heartbeat.pop(name, None)
+            self._last_seen.pop(name, None)
+            self._not_ready_since.pop(name, None)
 
     # --- monitor loop --------------------------------------------------------
 
     def monitor_once(self, now: Optional[float] = None):
         now = now if now is not None else self._clock()
+        with self._deleted_lock:
+            deleted = list(self._deleted_nodes.items())
+        for name, when in deleted:
+            if self.node_informer.store.get(name) is not None:
+                # node re-registered under the same name: its pods are live
+                # again — stop treating it as deleted
+                with self._deleted_lock:
+                    self._deleted_nodes.pop(name, None)
+                continue
+            # keep re-scanning for the eviction-timeout window: the pod
+            # informer may deliver pods bound to this node after the node
+            # delete event arrived (cache lag), and a dropped entry would
+            # orphan them forever
+            done = self._evict_pods(name)
+            if done and now - when >= self.pod_eviction_timeout:
+                with self._deleted_lock:
+                    self._deleted_nodes.pop(name, None)
         for node in self.node_informer.store.list():
             name = node.metadata.name
             hb = _heartbeat_of(node)
-            prev = self._last_heartbeat.get(name)
-            if hb != prev:
-                self._last_heartbeat[name] = hb
-                self._last_seen[name] = now
-            last_seen = self._last_seen.get(name, now)
             ready = _is_ready(node)
-            if ready and now - last_seen <= self.grace_period:
-                self._not_ready_since.pop(name, None)
-                continue
-            # stale heartbeat or explicitly NotReady
-            since = self._not_ready_since.setdefault(name, now)
-            if now - last_seen > self.grace_period and ready:
+            with self._deleted_lock:
+                if name in self._deleted_nodes:
+                    continue  # deleted concurrently; tracking state dropped
+                prev = self._last_heartbeat.get(name)
+                if hb != prev:
+                    self._last_heartbeat[name] = hb
+                    self._last_seen[name] = now
+                last_seen = self._last_seen.get(name, now)
+                if ready and now - last_seen <= self.grace_period:
+                    self._not_ready_since.pop(name, None)
+                    continue
+                # stale heartbeat or explicitly NotReady
+                since = self._not_ready_since.setdefault(name, now)
+                stale = now - last_seen > self.grace_period
+            if stale and ready:
                 self._mark_unknown(node)
             if now - since >= self.pod_eviction_timeout:
                 self._evict_pods(name)
@@ -87,12 +122,14 @@ class NodeController:
         except ApiError:
             pass
 
-    def _evict_pods(self, node_name: str):
+    def _evict_pods(self, node_name: str) -> bool:
+        """Returns True when no pods remain bound to node_name."""
         pods = [p for p in self.pod_informer.store.list()
                 if p.spec and p.spec.node_name == node_name]
+        ok = True
         for pod in pods:
             if not self.eviction_limiter.try_accept():
-                return  # rate limited: resume next tick
+                return False  # rate limited: resume next tick
             try:
                 self.client.delete("pods", pod.metadata.name,
                                    pod.metadata.namespace)
@@ -101,6 +138,8 @@ class NodeController:
             except ApiError as e:
                 if not e.is_not_found:
                     log.warning("evicting %s failed: %s", pod.metadata.name, e)
+                    ok = False
+        return ok
 
     # --- lifecycle -----------------------------------------------------------
 

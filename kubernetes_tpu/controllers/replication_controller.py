@@ -18,6 +18,7 @@ from kubernetes_tpu.api.serialization import deep_copy
 from kubernetes_tpu.client import Informer, ListWatch, RESTClient
 from kubernetes_tpu.client.rest import ApiError
 from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.expectations import ControllerExpectations
 
 log = logging.getLogger("rc-controller")
 
@@ -32,14 +33,29 @@ class ReplicationManager(Controller):
         self.burst = burst_replicas
         self.rc_informer = Informer(ListWatch(client, "replicationcontrollers"))
         self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.expectations = ControllerExpectations()
         self.rc_informer.add_event_handler(
             on_add=lambda rc: self.enqueue(_key(rc)),
             on_update=lambda old, new: self.enqueue(_key(new)),
-            on_delete=lambda rc: self.enqueue(_key(rc)))
+            on_delete=self._rc_deleted)
         self.pod_informer.add_event_handler(
-            on_add=self._pod_changed,
+            on_add=self._pod_added,
             on_update=lambda old, new: self._pod_changed(new),
-            on_delete=self._pod_changed)
+            on_delete=self._pod_deleted)
+
+    def _rc_deleted(self, rc: api.ReplicationController):
+        self.expectations.delete_expectations(_key(rc))
+        self.enqueue(_key(rc))
+
+    def _pod_added(self, pod: api.Pod):
+        for rc in self._controllers_for(pod):
+            self.expectations.creation_observed(_key(rc))
+            self.enqueue(_key(rc))
+
+    def _pod_deleted(self, pod: api.Pod):
+        for rc in self._controllers_for(pod):
+            self.expectations.deletion_observed(_key(rc))
+            self.enqueue(_key(rc))
 
     def _pod_changed(self, pod: api.Pod):
         for rc in self._controllers_for(pod):
@@ -69,21 +85,46 @@ class ReplicationManager(Controller):
                 and p.metadata.deletion_timestamp is None
                 and _is_active(p)
                 and sel.matches(p.metadata.labels or {})]
+        if self.expectations.satisfied_expectations(key):
+            self._manage_replicas(key, rc, pods)
+        self._update_status(rc, pods)
+
+    def _manage_replicas(self, key: str, rc: api.ReplicationController,
+                         pods: list) -> None:
+        ns = rc.metadata.namespace
         diff = (rc.spec.replicas or 0) - len(pods)
         if diff > 0:
-            for _ in range(min(diff, self.burst)):
-                self._create_pod(rc)
+            n = min(diff, self.burst)
+            self.expectations.expect_creations(key, n)
+            created = 0
+            try:
+                for _ in range(n):
+                    self._create_pod(rc)
+                    created += 1
+            except ApiError:
+                # the watch will never deliver the failed + untried pods;
+                # un-expect all of them so the requeued sync isn't blocked
+                # for the full expectations timeout
+                for _ in range(n - created):
+                    self.expectations.creation_observed(key)
+                raise
         elif diff < 0:
             # delete surplus: prefer unassigned, then unready (the reference
             # sorts by activePods ranking)
             victims = sorted(pods, key=_deletion_rank)[: min(-diff, self.burst)]
-            for p in victims:
+            self.expectations.expect_deletions(key, len(victims))
+            for i, p in enumerate(victims):
                 try:
                     self.client.delete("pods", p.metadata.name, ns)
                 except ApiError as e:
-                    if not e.is_not_found:
-                        raise
-        self._update_status(rc, pods)
+                    if e.is_not_found:
+                        self.expectations.deletion_observed(key)
+                        continue
+                    # un-expect the failed + untried deletions before the
+                    # requeue, same reasoning as the create path
+                    for _ in range(len(victims) - i):
+                        self.expectations.deletion_observed(key)
+                    raise
 
     def _create_pod(self, rc: api.ReplicationController):
         tpl = rc.spec.template or api.PodTemplateSpec()

@@ -63,15 +63,13 @@ class HollowCluster:
         self._zone_count = zone_count
         self._resources = dict(cpu=cpu, memory=memory, pods=pods)
         self._kubelets: Dict[str, Kubelet] = {}
-        self._stop_evt = __import__("threading").Event()
+        self._stop_evt = threading.Event()
         self._hb_thread = None
 
     def start(self, heartbeat_period: float = 30.0):
         # register all nodes first (bulk), then one shared informer feeds
         # every hollow kubelet's runtime, and one shared thread heartbeats
         # all of them (per-node loops don't scale to thousands in-process)
-        import threading
-
         for i in range(self._num):
             name = f"hollow-{i:05d}"
             labels = {api.LABEL_HOSTNAME: name,

@@ -42,7 +42,6 @@ class Proxier:
         self.client = client
         self.iptables = iptables or FakeIptables()
         self.node_name = node_name
-        self._lock = threading.Lock()
         self.svc_informer = Informer(ListWatch(client, "services"))
         self.ep_informer = Informer(ListWatch(client, "endpoints"))
         # handlers only mark dirty; a single sync loop coalesces bursts into

@@ -287,3 +287,122 @@ class TestLeaderElection:
         _wait(lambda: b.is_leader, timeout=5)
         assert started == ["a", "b"]
         b.stop()
+
+
+class TestReviewRegressions:
+    """Regression coverage for cache-lag over-creation, swallowed conflicts,
+    dead-node eviction, and leader re-acquisition."""
+
+    def test_leader_reacquires_after_losing_lease(self, client):
+        import json as _json
+
+        from kubernetes_tpu.client.leaderelection import LEADER_ANNOTATION
+
+        started, stopped = [], []
+        el = LeaderElector(
+            client,
+            LeaderElectionConfig(lock_name="relock", identity="a",
+                                 lease_duration=0.6, renew_deadline=0.4,
+                                 retry_period=0.05),
+            on_started_leading=lambda: started.append("a"),
+            on_stopped_leading=lambda: stopped.append("a"))
+        el.run()
+        _wait(lambda: el.is_leader)
+        # another process steals the lease (fresh record, different holder)
+        ep = client.get("endpoints", "relock", "kube-system")
+        ep.metadata.annotations[LEADER_ANNOTATION] = _json.dumps({
+            "holderIdentity": "thief",
+            "leaseDurationSeconds": 1,
+            "acquireTime": time.time(), "renewTime": time.time()})
+        client.update("endpoints", ep, "kube-system")
+        _wait(lambda: stopped == ["a"], timeout=5)
+        # the thief never renews; el must re-enter acquire and lead again
+        _wait(lambda: started == ["a", "a"] and el.is_leader, timeout=5)
+        el.stop()
+
+    def test_rc_expectations_prevent_double_create(self, client):
+        # informer stores populated manually and never updated -> simulates
+        # worst-case cache lag; without expectations the second sync would
+        # create another full replica set
+        rm = ReplicationManager(client)
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=3, selector={"app": "web"},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "web"}),
+                    spec=api.PodSpec(containers=[
+                        api.Container(name="c", image="pause")]))))
+        created = client.create("replicationcontrollers", rc, "default")
+        rm.rc_informer.store.add("default/web", created)
+        rm.sync("default/web")
+        rm.sync("default/web")  # cache still shows 0 pods
+        pods = [p for p in client.list("pods", "default")[0]
+                if (p.metadata.labels or {}).get("app") == "web"]
+        assert len(pods) == 3
+
+    def test_endpoints_conflict_raises_for_requeue(self, client, monkeypatch):
+        from kubernetes_tpu.client.rest import ApiError
+
+        ec = EndpointsController(client)
+        svc = api.Service(
+            metadata=api.ObjectMeta(name="s1", namespace="default"),
+            spec=api.ServiceSpec(selector={"app": "x"},
+                                 ports=[api.ServicePort(port=80)]))
+        client.create("services", svc, "default")
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="px", namespace="default",
+                                    labels={"app": "x"}),
+            spec=api.PodSpec(containers=[api.Container(name="c", image="i")]),
+            status=api.PodStatus(pod_ip="10.0.0.9", phase=api.POD_RUNNING))
+        client.create("pods", pod, "default")
+        ec.svc_informer.store.add("default/s1", client.get("services", "s1", "default"))
+        ec.pod_informer.store.add("default/px", client.get("pods", "px", "default"))
+        ec.sync("default/s1")  # creates endpoints
+
+        # next write conflicts -> sync must raise so the worker requeues
+        calls = {}
+
+        def conflicting_update(*a, **kw):
+            calls["hit"] = True
+            raise ApiError(409, "Conflict", "simulated concurrent write")
+
+        monkeypatch.setattr(client, "update", conflicting_update)
+        # a second ready pod changes the desired subsets so sync reaches update
+        pod2 = api.Pod(
+            metadata=api.ObjectMeta(name="py", namespace="default",
+                                    labels={"app": "x"}),
+            spec=api.PodSpec(containers=[api.Container(name="c", image="i")]),
+            status=api.PodStatus(pod_ip="10.0.0.10", phase=api.POD_RUNNING))
+        ec.pod_informer.store.add("default/py", pod2)
+        with pytest.raises(ApiError):
+            ec.sync("default/s1")
+        assert calls.get("hit")
+
+    def test_node_delete_evicts_bound_pods(self, client):
+        nc = NodeController(client, monitor_period=0.1, eviction_qps=1000.0)
+        node = api.Node(
+            metadata=api.ObjectMeta(name="doomed"),
+            status=api.NodeStatus(conditions=[api.NodeCondition(
+                type=api.NODE_READY, status=api.CONDITION_TRUE)]))
+        client.create("nodes", node)
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="orphan", namespace="default"),
+            spec=api.PodSpec(node_name="doomed",
+                             containers=[api.Container(name="c", image="i")]))
+        client.create("pods", pod, "default")
+        nc.start()
+        try:
+            _wait(lambda: nc.node_informer.store.get("doomed") is not None)
+            client.delete("nodes", "doomed")
+            _wait(lambda: _pod_gone(client, "orphan"), timeout=10)
+        finally:
+            nc.stop()
+
+
+def _pod_gone(client, name):
+    try:
+        client.get("pods", name, "default")
+        return False
+    except Exception:
+        return True
