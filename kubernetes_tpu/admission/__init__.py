@@ -1,0 +1,22 @@
+"""Admission control: mutating/validating plugin chain run between request
+decode and storage.
+
+Parity target: reference pkg/admission/ (Interface/Attributes, chain) plus the
+plugin inventory of plugin/pkg/admission/ (SURVEY §2.3): NamespaceLifecycle,
+NamespaceExists, NamespaceAutoProvision, LimitRanger, ResourceQuota,
+ServiceAccount, AlwaysPullImages, SecurityContextDeny, AntiAffinity (the
+LimitPodHardAntiAffinityTopology plugin), DenyExecOnPrivileged.
+"""
+
+from kubernetes_tpu.admission.interface import (  # noqa: F401
+    AdmissionChain,
+    AdmissionError,
+    Attributes,
+    CREATE,
+    DELETE,
+    Plugin,
+    UPDATE,
+    new_chain,
+    register_plugin,
+)
+from kubernetes_tpu.admission import plugins  # noqa: F401  (registers built-ins)

@@ -1,0 +1,89 @@
+"""Admission interface + chain.
+
+Reference pkg/admission/interfaces.go (Attributes, Interface.Admit) and
+pkg/admission/chain.go (chainAdmissionHandler runs plugins in order, first
+error wins). Plugins may mutate attrs.obj (mutating admission) or raise
+AdmissionError (validating admission). The plugin registry mirrors
+admission.RegisterPlugin / --admission-control flag parsing
+(cmd/kube-apiserver/app/server.go admission assembly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+CREATE = "CREATE"
+UPDATE = "UPDATE"
+DELETE = "DELETE"
+CONNECT = "CONNECT"
+
+
+class AdmissionError(Exception):
+    """Rejection; surfaces as HTTP 403 Forbidden (the reference wraps plugin
+    errors in apierrors.NewForbidden)."""
+
+    def __init__(self, message: str, code: int = 403):
+        self.code = code
+        super().__init__(message)
+
+
+@dataclass
+class Attributes:
+    """Everything a plugin may inspect (reference admission.Attributes)."""
+
+    resource: str = ""          # plural, e.g. "pods"
+    subresource: str = ""
+    name: str = ""
+    namespace: str = ""
+    operation: str = CREATE
+    obj: object = None          # incoming object (mutable), None for DELETE
+    old_obj: object = None      # current object on UPDATE
+    kind: str = ""
+    user: Optional[object] = None  # auth.user.Info once authn is enabled
+
+
+class Plugin:
+    """Base plugin: override admit(). `handles` limits operations (reference
+    admission.Handler.Handles)."""
+
+    name = "Plugin"
+    handles = (CREATE, UPDATE, DELETE, CONNECT)
+
+    def admit(self, attrs: Attributes) -> None:
+        raise NotImplementedError
+
+
+class AdmissionChain:
+    """Runs plugins in registration order; first raise aborts the request
+    (reference chainAdmissionHandler.Admit)."""
+
+    def __init__(self, plugins: Optional[List[Plugin]] = None):
+        self.plugins = plugins or []
+
+    def admit(self, attrs: Attributes) -> None:
+        for p in self.plugins:
+            if attrs.operation in p.handles:
+                p.admit(attrs)
+
+
+_PLUGIN_FACTORIES: Dict[str, Callable[..., Plugin]] = {}
+
+
+def register_plugin(name: str, factory: Callable[..., Plugin]) -> None:
+    _PLUGIN_FACTORIES[name] = factory
+
+
+def new_chain(names: List[str], **kwargs) -> AdmissionChain:
+    """Build a chain from plugin names, comma-order preserved — the
+    --admission-control flag equivalent. kwargs (e.g. registry=) are passed to
+    each factory that wants them."""
+    plugins: List[Plugin] = []
+    for n in names:
+        try:
+            factory = _PLUGIN_FACTORIES[n]
+        except KeyError:
+            raise ValueError(f"unknown admission plugin {n!r}; known: "
+                             f"{sorted(_PLUGIN_FACTORIES)}") from None
+        plugins.append(factory(**kwargs))
+    return AdmissionChain(plugins)

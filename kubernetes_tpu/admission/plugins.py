@@ -1,0 +1,383 @@
+"""Built-in admission plugins.
+
+Parity target: plugin/pkg/admission/* (SURVEY §2.3):
+  namespace/lifecycle, namespace/exists, namespace/autoprovision,
+  limitranger, resourcequota, serviceaccount, alwayspullimages,
+  securitycontext/scdeny, antiaffinity.
+Each factory takes registry= (the in-process store view; the reference
+plugins use client informers the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from kubernetes_tpu.admission.interface import (
+    CREATE, DELETE, UPDATE, AdmissionError, Attributes, Plugin, register_plugin,
+)
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import format_memory, parse_cpu, parse_quantity
+
+
+def _registry_of(kw):
+    reg = kw.get("registry")
+    if reg is None:
+        raise ValueError("admission plugin requires registry=")
+    return reg
+
+
+# --- namespace plugins -------------------------------------------------------
+
+class NamespaceLifecycle(Plugin):
+    """Rejects writes into missing or terminating namespaces and deletion of
+    the protected namespaces (reference plugin/pkg/admission/namespace/lifecycle)."""
+
+    name = "NamespaceLifecycle"
+    handles = (CREATE, UPDATE, DELETE)
+    _IMMORTAL = ("default", "kube-system")
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource == "namespaces":
+            if attrs.operation == DELETE and attrs.name in self._IMMORTAL:
+                raise AdmissionError(
+                    f"namespace {attrs.name!r} is immortal and cannot be deleted")
+            return
+        if not attrs.namespace or attrs.operation != CREATE:
+            return
+        from kubernetes_tpu.registry.generic import RegistryError
+        try:
+            ns = self.registry.get("namespaces", attrs.namespace)
+        except RegistryError:
+            if attrs.namespace == "default":
+                return  # default namespace is implicit
+            raise AdmissionError(
+                f"namespace {attrs.namespace!r} not found", code=404) from None
+        phase = ns.status.phase if ns.status else ""
+        if phase == "Terminating" or (ns.metadata and ns.metadata.deletion_timestamp):
+            raise AdmissionError(
+                f"namespace {attrs.namespace!r} is terminating; "
+                f"cannot create new content")
+
+
+class NamespaceExists(Plugin):
+    """Rejects any namespaced request whose namespace doesn't exist."""
+
+    name = "NamespaceExists"
+    handles = (CREATE, UPDATE, DELETE)
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, attrs: Attributes) -> None:
+        if not attrs.namespace or attrs.resource == "namespaces":
+            return
+        from kubernetes_tpu.registry.generic import RegistryError
+        try:
+            self.registry.get("namespaces", attrs.namespace)
+        except RegistryError:
+            if attrs.namespace == "default":
+                return
+            raise AdmissionError(
+                f"namespace {attrs.namespace!r} does not exist", code=404) from None
+
+
+class NamespaceAutoProvision(Plugin):
+    """Creates the namespace on first use (reference namespace/autoprovision)."""
+
+    name = "NamespaceAutoProvision"
+    handles = (CREATE,)
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, attrs: Attributes) -> None:
+        if not attrs.namespace or attrs.resource == "namespaces":
+            return
+        from kubernetes_tpu.registry.generic import RegistryError
+        try:
+            self.registry.get("namespaces", attrs.namespace)
+        except RegistryError:
+            try:
+                self.registry.create("namespaces", api.Namespace(
+                    metadata=api.ObjectMeta(name=attrs.namespace)))
+            except RegistryError:
+                pass  # raced another request; fine
+
+
+# --- LimitRanger -------------------------------------------------------------
+
+class LimitRanger(Plugin):
+    """Applies LimitRange defaults to pod containers and enforces min/max
+    (reference plugin/pkg/admission/limitranger)."""
+
+    name = "LimitRanger"
+    handles = (CREATE, UPDATE)
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        pod: api.Pod = attrs.obj
+        ranges, _ = self.registry.list("limitranges", attrs.namespace)
+        for lr in ranges:
+            for item in (lr.spec.limits if lr.spec else None) or []:
+                if item.type == "Container":
+                    self._apply_container_item(pod, item)
+                elif item.type == "Pod":
+                    self._check_pod_item(pod, item)
+
+    @staticmethod
+    def _apply_container_item(pod: api.Pod, item: api.LimitRangeItem):
+        for c in (pod.spec.containers if pod.spec else None) or []:
+            if c.resources is None:
+                c.resources = api.ResourceRequirements()
+            req = dict(c.resources.requests or {})
+            lim = dict(c.resources.limits or {})
+            for rname, v in (item.default_request or {}).items():
+                req.setdefault(rname, v)
+            for rname, v in (item.default or {}).items():
+                lim.setdefault(rname, v)
+                req.setdefault(rname, v)
+            c.resources.requests = req or None
+            c.resources.limits = lim or None
+            for rname, vmax in (item.max or {}).items():
+                used = lim.get(rname) or req.get(rname)
+                if used is not None and _parse(rname, used) > _parse(rname, vmax):
+                    raise AdmissionError(
+                        f"maximum {rname} usage per Container is {vmax}, "
+                        f"but container {c.name!r} asks for {used}")
+            for rname, vmin in (item.min or {}).items():
+                used = req.get(rname) or lim.get(rname)
+                if used is None or _parse(rname, used) < _parse(rname, vmin):
+                    raise AdmissionError(
+                        f"minimum {rname} usage per Container is {vmin}, "
+                        f"but container {c.name!r} asks for {used or 0}")
+
+    @staticmethod
+    def _check_pod_item(pod: api.Pod, item: api.LimitRangeItem):
+        totals: Dict[str, int] = {}
+        for c in (pod.spec.containers if pod.spec else None) or []:
+            for rname, v in ((c.resources.requests if c.resources else None) or {}).items():
+                totals[rname] = totals.get(rname, 0) + _parse(rname, v)
+        for rname, vmax in (item.max or {}).items():
+            if totals.get(rname, 0) > _parse(rname, vmax):
+                raise AdmissionError(
+                    f"maximum {rname} usage per Pod is {vmax}")
+        for rname, vmin in (item.min or {}).items():
+            if totals.get(rname, 0) < _parse(rname, vmin):
+                raise AdmissionError(
+                    f"minimum {rname} usage per Pod is {vmin}")
+
+
+def _parse(rname: str, v) -> int:
+    return parse_cpu(v) if rname == api.RESOURCE_CPU else parse_quantity(v)
+
+
+# --- ResourceQuota -----------------------------------------------------------
+
+# object-count quota keys (reference pkg/quota evaluator registry)
+_COUNT_KEYS = {
+    "pods": "pods", "services": "services",
+    "replicationcontrollers": "replicationcontrollers",
+    "secrets": "secrets", "configmaps": "configmaps",
+    "persistentvolumeclaims": "persistentvolumeclaims",
+}
+
+
+def quota_usage_of(resource: str, obj) -> Dict[str, int]:
+    """Usage delta one object contributes (reference quota evaluators).
+    cpu/memory are canonical ints (milliCPU / bytes)."""
+    usage: Dict[str, int] = {}
+    key = _COUNT_KEYS.get(resource)
+    if key:
+        usage[key] = 1
+    if resource == "pods" and obj is not None:
+        req = api.pod_resource_request(obj)
+        usage[api.RESOURCE_CPU] = req[api.RESOURCE_CPU]
+        usage[api.RESOURCE_MEMORY] = req[api.RESOURCE_MEMORY]
+    return usage
+
+
+def format_usage(rname: str, v: int) -> str:
+    if rname == api.RESOURCE_CPU:
+        return f"{v}m"
+    if rname == api.RESOURCE_MEMORY:
+        return format_memory(v)
+    return str(v)
+
+
+class ResourceQuotaPlugin(Plugin):
+    """Checks and books quota usage at admission time with a CAS on the
+    ResourceQuota status (reference plugin/pkg/admission/resourcequota keeps
+    an atomic increment against the quota document the same way)."""
+
+    name = "ResourceQuota"
+    handles = (CREATE, DELETE)
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, attrs: Attributes) -> None:
+        if not attrs.namespace:
+            return
+        obj = attrs.obj
+        sign = 1
+        if attrs.operation == DELETE:
+            # releasing usage: charge the negated footprint of the object
+            # being deleted
+            from kubernetes_tpu.registry.generic import RegistryError
+            try:
+                obj = self.registry.get(attrs.resource, attrs.name, attrs.namespace)
+            except RegistryError:
+                return
+            sign = -1
+        delta = quota_usage_of(attrs.resource, obj)
+        if not delta:
+            return
+        quotas, _ = self.registry.list("resourcequotas", attrs.namespace)
+        for q in quotas:
+            self._charge(q, {k: sign * v for k, v in delta.items()}, attrs)
+
+    def release_create(self, attrs: Attributes) -> None:
+        """Compensation hook: the apiserver calls this when a create fails
+        after admission charged it, so the booking is rolled back."""
+        delta = quota_usage_of(attrs.resource, attrs.obj)
+        if not delta:
+            return
+        quotas, _ = self.registry.list("resourcequotas", attrs.namespace)
+        for q in quotas:
+            self._charge(q, {k: -v for k, v in delta.items()}, attrs)
+
+    def _charge(self, q: api.ResourceQuota, delta: Dict[str, int],
+                attrs: Attributes):
+        hard = (q.spec.hard if q.spec else None) or {}
+        relevant = {k: v for k, v in delta.items() if k in hard}
+        if not relevant:
+            return
+
+        def bump(cur: api.ResourceQuota):
+            if cur.status is None:
+                cur.status = api.ResourceQuotaStatus()
+            used = dict(cur.status.used or {})
+            for rname, dv in relevant.items():
+                limit = _parse(rname, hard[rname])
+                cur_used = _parse(rname, used.get(rname, 0))
+                if dv > 0 and cur_used + dv > limit:
+                    raise AdmissionError(
+                        f"exceeded quota: {cur.metadata.name}, "
+                        f"requested: {rname}={format_usage(rname, dv)}, "
+                        f"used: {rname}={format_usage(rname, cur_used)}, "
+                        f"limited: {rname}={hard[rname]}")
+                used[rname] = format_usage(rname, max(0, cur_used + dv))
+            cur.status.hard = dict(hard)
+            cur.status.used = used
+            return cur
+
+        self.registry.guaranteed_update(
+            "resourcequotas", q.metadata.name, attrs.namespace, bump)
+
+
+# --- ServiceAccount ----------------------------------------------------------
+
+class ServiceAccountPlugin(Plugin):
+    """Defaults pod.spec.serviceAccountName to "default" (reference
+    plugin/pkg/admission/serviceaccount; token mounting is the kubelet's
+    concern in our split)."""
+
+    name = "ServiceAccount"
+    handles = (CREATE,)
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        pod: api.Pod = attrs.obj
+        if pod.spec and not pod.spec.service_account_name:
+            pod.spec.service_account_name = "default"
+
+
+# --- image / security policy -------------------------------------------------
+
+class AlwaysPullImages(Plugin):
+    """Forces imagePullPolicy=Always (reference plugin/pkg/admission/alwayspullimages)."""
+
+    name = "AlwaysPullImages"
+    handles = (CREATE, UPDATE)
+
+    def __init__(self, registry=None):
+        pass
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        for c in (attrs.obj.spec.containers if attrs.obj.spec else None) or []:
+            c.image_pull_policy = "Always"
+
+
+class SecurityContextDeny(Plugin):
+    """Denies privileged containers and runAsUser overrides (reference
+    plugin/pkg/admission/securitycontext/scdeny)."""
+
+    name = "SecurityContextDeny"
+    handles = (CREATE, UPDATE)
+
+    def __init__(self, registry=None):
+        pass
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        for c in (attrs.obj.spec.containers if attrs.obj.spec else None) or []:
+            sc = c.security_context
+            if sc is None:
+                continue
+            if sc.privileged:
+                raise AdmissionError(
+                    f"container {c.name!r}: privileged containers are not allowed")
+            if sc.run_as_user is not None or sc.se_linux_options:
+                raise AdmissionError(
+                    f"container {c.name!r}: SecurityContext overrides are not allowed")
+
+
+class AntiAffinityLimit(Plugin):
+    """Denies pods with hard pod anti-affinity on any topology key other than
+    the hostname label (reference plugin/pkg/admission/antiaffinity
+    LimitPodHardAntiAffinityTopology)."""
+
+    name = "LimitPodHardAntiAffinityTopology"
+    handles = (CREATE,)
+
+    def __init__(self, registry=None):
+        pass
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        affinity = attrs.obj.spec.affinity if attrs.obj.spec else None
+        anti = affinity.pod_anti_affinity if affinity else None
+        for term in (anti.required_during_scheduling_ignored_during_execution
+                     if anti else None) or []:
+            if term.topology_key and term.topology_key != api.LABEL_HOSTNAME:
+                raise AdmissionError(
+                    "pod with hard anti-affinity on topology key "
+                    f"{term.topology_key!r} is not allowed (only "
+                    f"{api.LABEL_HOSTNAME})")
+
+
+register_plugin("NamespaceLifecycle", lambda **kw: NamespaceLifecycle(_registry_of(kw)))
+register_plugin("NamespaceExists", lambda **kw: NamespaceExists(_registry_of(kw)))
+register_plugin("NamespaceAutoProvision",
+                lambda **kw: NamespaceAutoProvision(_registry_of(kw)))
+register_plugin("LimitRanger", lambda **kw: LimitRanger(_registry_of(kw)))
+register_plugin("ResourceQuota", lambda **kw: ResourceQuotaPlugin(_registry_of(kw)))
+register_plugin("ServiceAccount", lambda **kw: ServiceAccountPlugin(_registry_of(kw)))
+register_plugin("AlwaysPullImages", lambda **kw: AlwaysPullImages())
+register_plugin("SecurityContextDeny", lambda **kw: SecurityContextDeny())
+register_plugin("LimitPodHardAntiAffinityTopology", lambda **kw: AntiAffinityLimit())

@@ -290,6 +290,14 @@ class EnvVar:
 
 
 @dataclass
+class SecurityContext:
+    privileged: Optional[bool] = None
+    run_as_user: Optional[int] = None
+    run_as_non_root: Optional[bool] = None
+    se_linux_options: Optional[Dict[str, str]] = None
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
@@ -298,6 +306,42 @@ class Container:
     ports: Optional[List[ContainerPort]] = None
     env: Optional[List[EnvVar]] = None
     resources: Optional[ResourceRequirements] = None
+    image_pull_policy: str = ""  # Always | IfNotPresent | Never
+    security_context: Optional[SecurityContext] = None
+    liveness_probe: Optional["Probe"] = None
+    readiness_probe: Optional["Probe"] = None
+
+
+@dataclass
+class ExecAction:
+    command: Optional[List[str]] = None
+
+
+@dataclass
+class HTTPGetAction:
+    path: str = ""
+    port: Optional[object] = None  # int | named port
+    host: str = ""
+    scheme: str = "HTTP"
+
+
+@dataclass
+class TCPSocketAction:
+    port: Optional[object] = None
+
+
+@dataclass
+class Probe:
+    """Liveness/readiness probe (reference pkg/api/types.go Probe; handlers in
+    pkg/probe/{exec,http,tcp})."""
+    exec: Optional[ExecAction] = api_field("exec", default=None)
+    http_get: Optional[HTTPGetAction] = None
+    tcp_socket: Optional[TCPSocketAction] = None
+    initial_delay_seconds: int = 0
+    timeout_seconds: int = 1
+    period_seconds: int = 10
+    success_threshold: int = 1
+    failure_threshold: int = 3
 
 
 @dataclass
@@ -638,6 +682,83 @@ class PersistentVolumeClaim:
     status: Optional[PersistentVolumeClaimStatus] = None
 
 
+# --- config/identity objects (reference pkg/api/types.go Secret/ConfigMap/
+# ServiceAccount/LimitRange/ResourceQuota sections) ---------------------------
+
+@dataclass
+class LocalObjectReference:
+    name: str = ""
+
+
+@dataclass
+class Secret:
+    """Reference pkg/api/types.go Secret: opaque named data; values are
+    base64 strings on the wire."""
+    metadata: Optional[ObjectMeta] = None
+    data: Optional[Dict[str, str]] = None
+    type: str = "Opaque"
+
+
+SECRET_TYPE_SERVICE_ACCOUNT_TOKEN = "kubernetes.io/service-account-token"
+ANN_SERVICE_ACCOUNT_NAME = "kubernetes.io/service-account.name"
+ANN_SERVICE_ACCOUNT_UID = "kubernetes.io/service-account.uid"
+
+
+@dataclass
+class ConfigMap:
+    metadata: Optional[ObjectMeta] = None
+    data: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class ServiceAccount:
+    metadata: Optional[ObjectMeta] = None
+    secrets: Optional[List[ObjectReference]] = None
+    image_pull_secrets: Optional[List[LocalObjectReference]] = None
+
+
+@dataclass
+class LimitRangeItem:
+    """One constraint row (reference LimitRangeItem): type is Pod|Container;
+    maps are resource-name -> quantity string."""
+    type: str = ""
+    max: Optional[Dict[str, str]] = None
+    min: Optional[Dict[str, str]] = None
+    default: Optional[Dict[str, str]] = None
+    default_request: Optional[Dict[str, str]] = None
+    max_limit_request_ratio: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: Optional[List[LimitRangeItem]] = None
+
+
+@dataclass
+class LimitRange:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[LimitRangeSpec] = None
+
+
+@dataclass
+class ResourceQuotaSpec:
+    hard: Optional[Dict[str, str]] = None
+    scopes: Optional[List[str]] = None
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: Optional[Dict[str, str]] = None
+    used: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class ResourceQuota:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[ResourceQuotaSpec] = None
+    status: Optional[ResourceQuotaStatus] = None
+
+
 # --- status (error payloads, reference pkg/api/unversioned Status) -----------
 
 @dataclass
@@ -661,6 +782,11 @@ _V1_KINDS = {
     "Event": Event,
     "PersistentVolume": PersistentVolume,
     "PersistentVolumeClaim": PersistentVolumeClaim,
+    "Secret": Secret,
+    "ConfigMap": ConfigMap,
+    "ServiceAccount": ServiceAccount,
+    "LimitRange": LimitRange,
+    "ResourceQuota": ResourceQuota,
     "Status": Status,
 }
 for _kind, _cls in _V1_KINDS.items():

@@ -156,6 +156,149 @@ def validate_replication_controller(rc: api.ReplicationController) -> None:
         raise ValidationError(errs)
 
 
+def _selector_matches_template(selector, template, errs):
+    """The full LabelSelector (matchLabels + matchExpressions) must select the
+    template's labels (reference ValidateDeployment/ValidateJob selector checks)."""
+    if selector is None or template is None:
+        return
+    from kubernetes_tpu.api.labels import selector_from_label_selector
+    tpl_labels = (template.metadata.labels or {}) if template.metadata else {}
+    try:
+        sel = selector_from_label_selector(selector)
+    except ValueError as e:
+        errs.append(f"spec.selector: {e}")
+        return
+    _check(errs, sel.matches(tpl_labels),
+           "spec.template.metadata.labels: must satisfy spec.selector")
+
+
+def validate_deployment(d) -> None:
+    errs: List[str] = []
+    validate_object_meta(d.metadata, True, errs)
+    spec = d.spec
+    if spec is None:
+        errs.append("spec: required")
+    else:
+        if spec.replicas is not None:
+            _check(errs, spec.replicas >= 0, "spec.replicas: must be non-negative")
+        _check(errs, spec.template is not None, "spec.template: required")
+        _selector_matches_template(spec.selector, spec.template, errs)
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_daemonset(ds) -> None:
+    errs: List[str] = []
+    validate_object_meta(ds.metadata, True, errs)
+    if ds.spec is None:
+        errs.append("spec: required")
+    else:
+        _check(errs, ds.spec.template is not None, "spec.template: required")
+        _selector_matches_template(ds.spec.selector, ds.spec.template, errs)
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_job(job) -> None:
+    errs: List[str] = []
+    validate_object_meta(job.metadata, True, errs)
+    spec = job.spec
+    if spec is None:
+        errs.append("spec: required")
+    else:
+        if spec.parallelism is not None:
+            _check(errs, spec.parallelism >= 0, "spec.parallelism: must be non-negative")
+        if spec.completions is not None:
+            _check(errs, spec.completions >= 0, "spec.completions: must be non-negative")
+        _check(errs, spec.template is not None, "spec.template: required")
+        if spec.template and spec.template.spec:
+            _check(errs, spec.template.spec.restart_policy in ("Never", "OnFailure", "", None),
+                   "spec.template.spec.restartPolicy: must be Never or OnFailure")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_scheduled_job(sj) -> None:
+    errs: List[str] = []
+    validate_object_meta(sj.metadata, True, errs)
+    spec = sj.spec
+    if spec is None:
+        errs.append("spec: required")
+    else:
+        _check(errs, bool(spec.schedule), "spec.schedule: required")
+        if spec.schedule:
+            _check(errs, len(spec.schedule.split()) == 5,
+                   "spec.schedule: must be a 5-field cron expression")
+        _check(errs, spec.concurrency_policy in ("Allow", "Forbid", "Replace"),
+               "spec.concurrencyPolicy: must be Allow, Forbid or Replace")
+        _check(errs, spec.job_template is not None, "spec.jobTemplate: required")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_hpa(hpa) -> None:
+    errs: List[str] = []
+    validate_object_meta(hpa.metadata, True, errs)
+    spec = hpa.spec
+    if spec is None:
+        errs.append("spec: required")
+    else:
+        _check(errs, spec.scale_target_ref is not None and bool(spec.scale_target_ref.name),
+               "spec.scaleTargetRef.name: required")
+        _check(errs, spec.max_replicas >= 1, "spec.maxReplicas: must be >= 1")
+        if spec.min_replicas is not None:
+            _check(errs, 1 <= spec.min_replicas <= spec.max_replicas,
+                   "spec.minReplicas: must be >= 1 and <= maxReplicas")
+        if spec.target_cpu_utilization_percentage is not None:
+            _check(errs, spec.target_cpu_utilization_percentage >= 1,
+                   "spec.targetCPUUtilizationPercentage: must be >= 1")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_petset(ps) -> None:
+    errs: List[str] = []
+    validate_object_meta(ps.metadata, True, errs)
+    spec = ps.spec
+    if spec is None:
+        errs.append("spec: required")
+    else:
+        if spec.replicas is not None:
+            _check(errs, spec.replicas >= 0, "spec.replicas: must be non-negative")
+        _check(errs, spec.template is not None, "spec.template: required")
+        _selector_matches_template(spec.selector, spec.template, errs)
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_resource_quota(rq: api.ResourceQuota) -> None:
+    errs: List[str] = []
+    validate_object_meta(rq.metadata, True, errs)
+    if rq.spec and rq.spec.hard:
+        _validate_resource_list(rq.spec.hard, errs, "spec.hard")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_limit_range(lr: api.LimitRange) -> None:
+    errs: List[str] = []
+    validate_object_meta(lr.metadata, True, errs)
+    for i, item in enumerate((lr.spec.limits if lr.spec else None) or []):
+        _check(errs, item.type in ("Pod", "Container"),
+               f"spec.limits[{i}].type: must be Pod or Container")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_secret(s: api.Secret) -> None:
+    errs: List[str] = []
+    validate_object_meta(s.metadata, True, errs)
+    total = sum(len(v) for v in (s.data or {}).values())
+    _check(errs, total <= 1024 * 1024, "data: total size must be <= 1MiB")
+    if errs:
+        raise ValidationError(errs)
+
+
 VALIDATORS = {
     api.Pod: validate_pod,
     api.Node: validate_node,
@@ -163,6 +306,9 @@ VALIDATORS = {
     api.Binding: validate_binding,
     api.Namespace: validate_namespace,
     api.ReplicationController: validate_replication_controller,
+    api.ResourceQuota: validate_resource_quota,
+    api.LimitRange: validate_limit_range,
+    api.Secret: validate_secret,
 }
 
 

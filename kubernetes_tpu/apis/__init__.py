@@ -1,0 +1,27 @@
+"""API groups beyond core v1.
+
+Parity target: reference pkg/apis/ (extensions, batch, autoscaling, apps,
+policy, rbac, componentconfig — SURVEY §2.1). Each module registers its kinds
+into the shared serialization Scheme under the group's wire apiVersion, the
+same group-install pattern as pkg/apis/<g>/install.
+"""
+
+from kubernetes_tpu.apis import (  # noqa: F401  (import = register in scheme)
+    apps,
+    autoscaling,
+    batch,
+    componentconfig,
+    extensions,
+    policy,
+    rbac,
+)
+
+GROUPS = {
+    "extensions": "extensions/v1beta1",
+    "batch": "batch/v1",
+    "autoscaling": "autoscaling/v1",
+    "apps": "apps/v1alpha1",
+    "policy": "policy/v1alpha1",
+    "rbac.authorization.k8s.io": "rbac.authorization.k8s.io/v1alpha1",
+    "componentconfig": "componentconfig/v1alpha1",
+}

@@ -1,0 +1,43 @@
+"""apps/v1alpha1 group.
+
+Parity target: reference pkg/apis/apps/types.go — PetSet (the ancestor of
+StatefulSet): ordered, identity-preserving replicas with per-pet volume
+claims and a governing service.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.api.types import (
+    LabelSelector, ObjectMeta, PersistentVolumeClaim, PodTemplateSpec,
+)
+
+GROUP_VERSION = "apps/v1alpha1"
+
+
+@dataclass
+class PetSetSpec:
+    replicas: Optional[int] = None
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+    volume_claim_templates: Optional[List[PersistentVolumeClaim]] = None
+    service_name: str = ""
+
+
+@dataclass
+class PetSetStatus:
+    observed_generation: Optional[int] = None
+    replicas: int = 0
+
+
+@dataclass
+class PetSet:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[PetSetSpec] = None
+    status: Optional[PetSetStatus] = None
+
+
+scheme.add_known_type(GROUP_VERSION, "PetSet", PetSet)

@@ -1,0 +1,51 @@
+"""autoscaling/v1 group.
+
+Parity target: reference pkg/apis/autoscaling/types.go —
+HorizontalPodAutoscaler keyed on target CPU utilization percentage, scaling a
+CrossVersionObjectReference target through its scale subresource.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.api.types import ObjectMeta
+
+GROUP_VERSION = "autoscaling/v1"
+
+
+@dataclass
+class CrossVersionObjectReference:
+    kind: str = ""
+    name: str = ""
+    api_version: str = ""
+
+
+@dataclass
+class HorizontalPodAutoscalerSpec:
+    scale_target_ref: Optional[CrossVersionObjectReference] = None
+    min_replicas: Optional[int] = None
+    max_replicas: int = 0
+    target_cpu_utilization_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscalerStatus:
+    observed_generation: Optional[int] = None
+    last_scale_time: Optional[str] = None
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[HorizontalPodAutoscalerSpec] = None
+    status: Optional[HorizontalPodAutoscalerStatus] = None
+
+
+scheme.add_known_type(GROUP_VERSION, "HorizontalPodAutoscaler",
+                      HorizontalPodAutoscaler)

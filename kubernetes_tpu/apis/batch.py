@@ -1,0 +1,96 @@
+"""batch/v1 (Job) and batch/v2alpha1 (ScheduledJob) groups.
+
+Parity target: reference pkg/apis/batch/types.go — JobSpec with
+parallelism/completions/activeDeadlineSeconds, JobCondition Complete/Failed,
+ScheduledJob with cron schedule + concurrency policy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.api.types import (
+    LabelSelector, ObjectMeta, ObjectReference, PodTemplateSpec,
+)
+
+GROUP_VERSION = "batch/v1"
+GROUP_VERSION_V2 = "batch/v2alpha1"
+
+JOB_COMPLETE = "Complete"
+JOB_FAILED = "Failed"
+
+# ConcurrencyPolicy (reference batch/types.go)
+ALLOW_CONCURRENT = "Allow"
+FORBID_CONCURRENT = "Forbid"
+REPLACE_CONCURRENT = "Replace"
+
+
+@dataclass
+class JobSpec:
+    parallelism: Optional[int] = None
+    completions: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    selector: Optional[LabelSelector] = None
+    manual_selector: Optional[bool] = None
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class JobCondition:
+    type: str = ""      # Complete | Failed
+    status: str = ""    # True | False | Unknown
+    last_probe_time: Optional[str] = None
+    last_transition_time: Optional[str] = None
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class JobStatus:
+    conditions: Optional[List[JobCondition]] = None
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class Job:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[JobSpec] = None
+    status: Optional[JobStatus] = None
+
+
+@dataclass
+class JobTemplateSpec:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[JobSpec] = None
+
+
+@dataclass
+class ScheduledJobSpec:
+    schedule: str = ""  # cron format
+    starting_deadline_seconds: Optional[int] = None
+    concurrency_policy: str = ALLOW_CONCURRENT
+    suspend: Optional[bool] = None
+    job_template: Optional[JobTemplateSpec] = None
+
+
+@dataclass
+class ScheduledJobStatus:
+    active: Optional[List[ObjectReference]] = None
+    last_schedule_time: Optional[str] = None
+
+
+@dataclass
+class ScheduledJob:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[ScheduledJobSpec] = None
+    status: Optional[ScheduledJobStatus] = None
+
+
+scheme.add_known_type(GROUP_VERSION, "Job", Job)
+scheme.add_known_type(GROUP_VERSION_V2, "ScheduledJob", ScheduledJob)

@@ -1,0 +1,71 @@
+"""componentconfig/v1alpha1 group.
+
+Parity target: reference pkg/apis/componentconfig/types.go — component flags
+are themselves versioned API objects (KubeSchedulerConfiguration built via
+Scheme conversion in plugin/cmd/kube-scheduler/app/options/options.go:40-74,
+exported live at /configz). The scheduler/proxy/kubelet entry points decode
+these and the configz registry serves them back.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from kubernetes_tpu.api.serialization import scheme
+
+GROUP_VERSION = "componentconfig/v1alpha1"
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    scheduler_name: str = "default-scheduler"
+    algorithm_provider: str = "DefaultProvider"
+    policy_config_file: str = ""
+    hard_pod_affinity_symmetric_weight: int = 1
+    failure_domains: str = ""
+    kube_api_qps: float = 50.0
+    kube_api_burst: int = 100
+    leader_election: Optional["LeaderElectionConfiguration"] = None
+    port: int = 10251
+    # TPU decision plane (no reference analog): enable the batched kernel
+    # and its shapes
+    tpu_backend: bool = False
+    tpu_batch_window_ms: int = 50
+
+
+@dataclass
+class LeaderElectionConfiguration:
+    leader_elect: bool = False
+    lease_duration_seconds: float = 15.0
+    renew_deadline_seconds: float = 10.0
+    retry_period_seconds: float = 2.0
+
+
+@dataclass
+class KubeProxyConfiguration:
+    bind_address: str = "0.0.0.0"
+    mode: str = "iptables"  # iptables | userspace
+    sync_period_seconds: float = 30.0
+    oom_score_adj: Optional[int] = None
+
+
+@dataclass
+class KubeletConfiguration:
+    address: str = "0.0.0.0"
+    port: int = 10250
+    max_pods: int = 110
+    sync_frequency_seconds: float = 60.0
+    node_status_update_frequency_seconds: float = 10.0
+    image_gc_high_threshold_percent: int = 90
+    image_gc_low_threshold_percent: int = 80
+    eviction_hard: str = "memory.available<100Mi"
+
+
+for _kind, _cls in {
+    "KubeSchedulerConfiguration": KubeSchedulerConfiguration,
+    "LeaderElectionConfiguration": LeaderElectionConfiguration,
+    "KubeProxyConfiguration": KubeProxyConfiguration,
+    "KubeletConfiguration": KubeletConfiguration,
+}.items():
+    scheme.add_known_type(GROUP_VERSION, _kind, _cls)

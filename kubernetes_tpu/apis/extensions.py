@@ -1,0 +1,192 @@
+"""extensions/v1beta1 group.
+
+Parity target: reference pkg/apis/extensions/types.go — Deployment (with
+rolling-update strategy and rollback), DaemonSet, Ingress, ThirdPartyResource,
+and the Scale subresource shared by rc/rs/deployments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.api.types import LabelSelector, ObjectMeta, PodTemplateSpec
+
+GROUP_VERSION = "extensions/v1beta1"
+
+# Deployment strategy types (reference extensions/types.go DeploymentStrategyType)
+RECREATE = "Recreate"
+ROLLING_UPDATE = "RollingUpdate"
+
+
+@dataclass
+class RollingUpdateDeployment:
+    """maxUnavailable/maxSurge accept an int or a percent string, like the
+    reference's IntOrString."""
+    max_unavailable: Optional[object] = None  # int | "25%"
+    max_surge: Optional[object] = None
+
+
+@dataclass
+class DeploymentStrategy:
+    type: str = ROLLING_UPDATE
+    rolling_update: Optional[RollingUpdateDeployment] = None
+
+
+@dataclass
+class RollbackConfig:
+    revision: int = 0
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: Optional[int] = None
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+    strategy: Optional[DeploymentStrategy] = None
+    min_ready_seconds: int = 0
+    revision_history_limit: Optional[int] = None
+    paused: bool = False
+    rollback_to: Optional[RollbackConfig] = None
+
+
+@dataclass
+class DeploymentStatus:
+    observed_generation: int = 0
+    replicas: int = 0
+    updated_replicas: int = 0
+    available_replicas: int = 0
+    unavailable_replicas: int = 0
+
+
+@dataclass
+class Deployment:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[DeploymentSpec] = None
+    status: Optional[DeploymentStatus] = None
+
+
+@dataclass
+class DeploymentRollback:
+    name: str = ""
+    updated_annotations: Optional[Dict[str, str]] = None
+    rollback_to: Optional[RollbackConfig] = None
+
+
+# revision annotation the deployment controller stamps on replica sets
+# (reference deployment/util deploymentutil.RevisionAnnotation)
+ANN_REVISION = "deployment.kubernetes.io/revision"
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class DaemonSetStatus:
+    current_number_scheduled: int = 0
+    number_misscheduled: int = 0
+    desired_number_scheduled: int = 0
+
+
+@dataclass
+class DaemonSet:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[DaemonSetSpec] = None
+    status: Optional[DaemonSetStatus] = None
+
+
+# --- Ingress -----------------------------------------------------------------
+
+@dataclass
+class IngressBackend:
+    service_name: str = ""
+    service_port: Optional[object] = None  # int | name
+
+
+@dataclass
+class HTTPIngressPath:
+    path: str = ""
+    backend: Optional[IngressBackend] = None
+
+
+@dataclass
+class HTTPIngressRuleValue:
+    paths: Optional[List[HTTPIngressPath]] = None
+
+
+@dataclass
+class IngressRule:
+    host: str = ""
+    http: Optional[HTTPIngressRuleValue] = None
+
+
+@dataclass
+class IngressTLS:
+    hosts: Optional[List[str]] = None
+    secret_name: str = ""
+
+
+@dataclass
+class IngressSpec:
+    backend: Optional[IngressBackend] = None
+    tls: Optional[List[IngressTLS]] = None
+    rules: Optional[List[IngressRule]] = None
+
+
+@dataclass
+class IngressStatus:
+    load_balancer: Optional[dict] = None
+
+
+@dataclass
+class Ingress:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[IngressSpec] = None
+    status: Optional[IngressStatus] = None
+
+
+@dataclass
+class APIVersion:
+    name: str = ""
+
+
+@dataclass
+class ThirdPartyResource:
+    metadata: Optional[ObjectMeta] = None
+    description: str = ""
+    versions: Optional[List[APIVersion]] = None
+
+
+# --- Scale subresource (reference extensions/types.go Scale) ------------------
+
+@dataclass
+class ScaleSpec:
+    replicas: int = 0
+
+
+@dataclass
+class ScaleStatus:
+    replicas: int = 0
+    selector: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class Scale:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[ScaleSpec] = None
+    status: Optional[ScaleStatus] = None
+
+
+for _kind, _cls in {
+    "Deployment": Deployment,
+    "DeploymentRollback": DeploymentRollback,
+    "DaemonSet": DaemonSet,
+    "Ingress": Ingress,
+    "ThirdPartyResource": ThirdPartyResource,
+    "Scale": Scale,
+}.items():
+    scheme.add_known_type(GROUP_VERSION, _kind, _cls)

@@ -1,0 +1,40 @@
+"""policy/v1alpha1 group.
+
+Parity target: reference pkg/apis/policy/types.go — PodDisruptionBudget:
+minAvailable (int or percent) over a label-selected pod set; status says
+whether a voluntary disruption is currently allowed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.api.types import LabelSelector, ObjectMeta
+
+GROUP_VERSION = "policy/v1alpha1"
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    min_available: Optional[object] = None  # int | "50%"
+    selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    disruption_allowed: bool = False
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[PodDisruptionBudgetSpec] = None
+    status: Optional[PodDisruptionBudgetStatus] = None
+
+
+scheme.add_known_type(GROUP_VERSION, "PodDisruptionBudget", PodDisruptionBudget)

@@ -1,0 +1,76 @@
+"""rbac.authorization.k8s.io/v1alpha1 group.
+
+Parity target: reference pkg/apis/rbac/types.go — PolicyRule, Role,
+RoleBinding, ClusterRole, ClusterRoleBinding. Consumed by the RBAC authorizer
+(kubernetes_tpu.auth.authorizer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.api.types import ObjectMeta, ObjectReference
+
+GROUP_VERSION = "rbac.authorization.k8s.io/v1alpha1"
+
+VERB_ALL = "*"
+APIGROUP_ALL = "*"
+RESOURCE_ALL = "*"
+
+# Subject kinds
+USER_KIND = "User"
+GROUP_KIND = "Group"
+SERVICE_ACCOUNT_KIND = "ServiceAccount"
+
+
+@dataclass
+class PolicyRule:
+    verbs: Optional[List[str]] = None
+    api_groups: Optional[List[str]] = None
+    resources: Optional[List[str]] = None
+    resource_names: Optional[List[str]] = None
+    non_resource_urls: Optional[List[str]] = None
+
+
+@dataclass
+class Subject:
+    kind: str = ""
+    name: str = ""
+    namespace: str = ""
+
+
+@dataclass
+class Role:
+    metadata: Optional[ObjectMeta] = None
+    rules: Optional[List[PolicyRule]] = None
+
+
+@dataclass
+class RoleBinding:
+    metadata: Optional[ObjectMeta] = None
+    subjects: Optional[List[Subject]] = None
+    role_ref: Optional[ObjectReference] = None
+
+
+@dataclass
+class ClusterRole:
+    metadata: Optional[ObjectMeta] = None
+    rules: Optional[List[PolicyRule]] = None
+
+
+@dataclass
+class ClusterRoleBinding:
+    metadata: Optional[ObjectMeta] = None
+    subjects: Optional[List[Subject]] = None
+    role_ref: Optional[ObjectReference] = None
+
+
+for _kind, _cls in {
+    "Role": Role,
+    "RoleBinding": RoleBinding,
+    "ClusterRole": ClusterRole,
+    "ClusterRoleBinding": ClusterRoleBinding,
+}.items():
+    scheme.add_known_type(GROUP_VERSION, _kind, _cls)

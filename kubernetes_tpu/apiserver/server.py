@@ -46,11 +46,11 @@ from kubernetes_tpu.storage import TooOldResourceVersion
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 _PATH = re.compile(
-    r"^/api/v1"
+    r"^(?:/api/v1|/apis/(?P<group>[a-z0-9.-]+)/(?P<gversion>v[a-z0-9]+))"
     r"(?:/namespaces/(?P<ns>[a-z0-9-]+))?"
     r"/(?P<resource>[a-z]+)"
     r"(?:/(?P<name>[A-Za-z0-9._-]+))?"
-    r"(?:/(?P<sub>status|binding))?$"
+    r"(?:/(?P<sub>status|binding|scale|rollback))?$"
 )
 
 
@@ -60,12 +60,25 @@ class APIServer:
     through the Registry directly."""
 
     def __init__(self, registry: Optional[Registry] = None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, admission_control: Optional[list] = None,
+                 authenticator=None, authorizer=None):
         self.registry = registry or Registry()
         self._host = host
         self._port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # admission chain (reference --admission-control flag; the chain runs
+        # between decode and storage, cmd/kube-apiserver/app/server.go)
+        self.admission = None
+        if admission_control:
+            from kubernetes_tpu.admission import AdmissionChain, new_chain
+            if isinstance(admission_control, AdmissionChain):
+                self.admission = admission_control
+            else:
+                self.admission = new_chain(admission_control, registry=self.registry)
+        # authn/authz chain (reference authn→authz filters before dispatch)
+        self.authenticator = authenticator
+        self.authorizer = authorizer
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -169,12 +182,27 @@ class _Handler(BaseHTTPRequestHandler):
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
 
         if url.path in ("/healthz", "/healthz/ping"):
+            # health probes stay unauthenticated (reference serves /healthz on
+            # the insecure port for liveness checks)
             return self._send_plain(200, b"ok")
+        if url.path in ("/version", "/metrics", "/api", "/apis"):
+            if not self._auth_nonresource(url.path):
+                return
         if url.path == "/version":
             return self._send_json(200, {"major": "0", "minor": "1",
                                          "gitVersion": "kubernetes-tpu-0.1"})
         if url.path == "/metrics":
             return self._send_plain(200, METRICS.render().encode())
+
+        if url.path == "/api":
+            return self._send_json(200, {"kind": "APIVersions",
+                                         "versions": ["v1"]})
+        if url.path == "/apis":
+            from kubernetes_tpu.apis import GROUPS
+            return self._send_json(200, {
+                "kind": "APIGroupList",
+                "groups": [{"name": g, "preferredVersion":
+                            {"groupVersion": gv}} for g, gv in GROUPS.items()]})
 
         m = _PATH.match(url.path)
         if not m:
@@ -183,11 +211,30 @@ class _Handler(BaseHTTPRequestHandler):
         resource = m.group("resource")
         name = m.group("name")
         sub = m.group("sub")
+        group = m.group("group")
+        gversion = m.group("gversion")
 
         # /api/v1/namespaces/{name}/status parses as ns + resource="status":
-        # reinterpret as the namespaces status subresource
+        # reinterpret as the namespaces status subresource (must happen before
+        # authz, which would otherwise see resource="status" ns=<name>)
         if ns and resource == "status" and not name:
             resource, name, sub, ns = "namespaces", ns, "status", ""
+
+        # a group resource must be addressed under its own group prefix and
+        # vice versa (reference: per-group route install, master.go:215)
+        if resource in RESOURCES:
+            want = RESOURCES[resource].api_version
+            got = f"{group}/{gversion}" if group else "v1"
+            if want != got:
+                return self._send_status(
+                    404, "NotFound",
+                    f"resource {resource!r} is served at {want!r}, not {got!r}")
+
+        # authn -> authz filters (reference pkg/apiserver/handlers.go chain;
+        # the insecure handler — no authenticator configured — skips both)
+        if not self._auth_filter(method, resource, name, ns, q,
+                                 group or "", sub or ""):
+            return
 
         # "bindings" is a virtual write-only resource backed by the pod
         # registry (reference BindingREST)
@@ -195,6 +242,30 @@ class _Handler(BaseHTTPRequestHandler):
             return self._serve_binding(ns)
         if resource not in RESOURCES:
             return self._send_status(404, "NotFound", f"unknown resource {resource!r}")
+
+        if sub == "scale":
+            from kubernetes_tpu.apis import extensions as ext
+            if method == "GET":
+                return self._send_obj(self.registry.get_scale(resource, name, ns))
+            if method == "PUT":
+                sc = scheme.decode_into(ext.Scale, self._read_body())
+                self._admit("UPDATE", resource, ns, name=name, obj=sc,
+                            sub="scale")
+                return self._send_obj(
+                    self.registry.update_scale(resource, name, ns, sc))
+            return self._send_status(405, "MethodNotAllowed",
+                                     f"{method} not supported on scale")
+        if sub == "rollback":
+            if method == "POST" and resource == "deployments":
+                from kubernetes_tpu.apis import extensions as ext
+                rb = scheme.decode_into(ext.DeploymentRollback, self._read_body())
+                self._admit("UPDATE", resource, ns, name=name, obj=rb,
+                            sub="rollback")
+                self.registry.rollback_deployment(name, ns, rb)
+                return self._send_json(200, {"kind": "Status", "status": "Success",
+                                             "message": "rollback request recorded"})
+            return self._send_status(405, "MethodNotAllowed",
+                                     f"{method} {resource} rollback not supported")
 
         if method == "GET" and not name:
             if q.get("watch") in ("true", "1"):
@@ -204,20 +275,135 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_obj(self.registry.get(resource, name, ns))
         if method == "POST" and not name:
             obj = scheme.decode_into(RESOURCES[resource].cls, self._read_body())
-            created = self.registry.create(resource, obj, namespace=ns)
+            self._admit("CREATE", resource, ns, obj=obj)
+            try:
+                created = self.registry.create(resource, obj, namespace=ns)
+            except RegistryError:
+                # a create that fails after admission must not strand side
+                # effects booked by mutating plugins (quota charges)
+                self._admit_release(resource, ns, obj)
+                raise
             return self._send_obj(created, 201)
         if method == "POST" and sub == "binding":
             return self._serve_binding(ns, pod_name=name)
         if method == "PUT" and name:
             obj = scheme.decode_into(RESOURCES[resource].cls, self._read_body())
             self._check_body_matches_url(obj, name, ns)
+            if not sub:
+                # subresource writes (status) skip admission, matching the
+                # reference (admission only guards main-resource mutations;
+                # kubelet status PATCHes must not be subject to LimitRanger)
+                self._admit("UPDATE", resource, ns, name=name, obj=obj)
             if sub == "status":
                 return self._send_obj(self.registry.update_status(resource, obj, ns))
             return self._send_obj(self.registry.update(resource, obj, namespace=ns))
         if method == "DELETE" and name:
+            self._admit("DELETE", resource, ns, name=name)
             return self._send_obj(self.registry.delete(resource, name, ns))
         return self._send_status(405, "MethodNotAllowed",
                                  f"{method} not supported here")
+
+    def _auth_nonresource(self, path: str) -> bool:
+        """Authn/authz for non-resource debug endpoints (/metrics, /api,
+        /apis, /version). ABAC nonResourcePath and RBAC nonResourceURLs rules
+        apply. Returns False after sending an error response."""
+        outer = self.server_ref
+        self._user = None
+        if outer is None or outer.authenticator is None:
+            return True
+        from kubernetes_tpu.auth import AuthenticationError, AuthzAttributes
+        try:
+            self._user = outer.authenticator.authenticate(self.headers)
+        except AuthenticationError as e:
+            self._send_status(401, "Unauthorized", str(e))
+            return False
+        if self._user is None:
+            self._send_status(401, "Unauthorized", "authentication required")
+            return False
+        if outer.authorizer is None:
+            return True
+        attrs = AuthzAttributes(user=self._user, verb="get",
+                                resource_request=False, path=path)
+        if not outer.authorizer.authorize(attrs):
+            self._send_status(403, "Forbidden",
+                              f'user {self._user.name!r} cannot get {path}')
+            return False
+        return True
+
+    def _auth_filter(self, method: str, resource: str, name, ns: str,
+                     q: dict, api_group: str, subresource: str = "") -> bool:
+        """Authenticate then authorize; returns False after sending an error
+        response. No-op when the server has no authenticator (insecure port)."""
+        outer = self.server_ref
+        self._user = None
+        if outer is None or outer.authenticator is None:
+            return True
+        from kubernetes_tpu.auth import AuthenticationError, AuthzAttributes
+        try:
+            self._user = outer.authenticator.authenticate(self.headers)
+        except AuthenticationError as e:
+            self._send_status(401, "Unauthorized", str(e))
+            return False
+        if self._user is None:
+            # no authenticator recognized the request (and no anonymous
+            # fallback was configured in the chain)
+            self._send_status(401, "Unauthorized", "authentication required")
+            return False
+        if outer.authorizer is None:
+            return True
+        if method == "GET":
+            verb = ("watch" if q.get("watch") in ("true", "1")
+                    else ("get" if name else "list"))
+        else:
+            verb = {"POST": "create", "PUT": "update",
+                    "DELETE": "delete"}.get(method, method.lower())
+        attrs = AuthzAttributes(user=self._user, verb=verb, resource=resource,
+                                subresource=subresource, namespace=ns,
+                                api_group=api_group, name=name or "")
+        if not outer.authorizer.authorize(attrs):
+            uname = self._user.name if self._user else "<anonymous>"
+            what = f"{resource}/{subresource}" if subresource else resource
+            self._send_status(403, "Forbidden",
+                              f'user {uname!r} cannot {verb} {what} '
+                              f'in namespace {ns!r}')
+            return False
+        return True
+
+    def _admit(self, op: str, resource: str, ns: str, name: str = "",
+               obj=None, sub: str = ""):
+        """Run the admission chain; rejections surface as HTTP errors
+        (reference resthandler wraps plugin errors in Forbidden)."""
+        adm = self.server_ref.admission if self.server_ref else None
+        if adm is None:
+            return
+        from kubernetes_tpu.admission import AdmissionError, Attributes
+        if not name and obj is not None and getattr(obj, "metadata", None):
+            name = obj.metadata.name
+        attrs = Attributes(resource=resource, subresource=sub, name=name,
+                           namespace=ns, operation=op, obj=obj,
+                           kind=type(obj).__name__ if obj is not None else "",
+                           user=getattr(self, "_user", None))
+        try:
+            adm.admit(attrs)
+        except AdmissionError as e:
+            raise RegistryError(e.code, "Forbidden", str(e)) from None
+
+    def _admit_release(self, resource: str, ns: str, obj):
+        """Undo admission side effects after a failed create: plugins exposing
+        release_create (ResourceQuota) get the rejected object back."""
+        adm = self.server_ref.admission if self.server_ref else None
+        if adm is None:
+            return
+        from kubernetes_tpu.admission import Attributes
+        attrs = Attributes(resource=resource, namespace=ns, operation="CREATE",
+                           obj=obj)
+        for p in adm.plugins:
+            release = getattr(p, "release_create", None)
+            if release is not None:
+                try:
+                    release(attrs)
+                except Exception:
+                    pass  # best-effort; periodic recalc is the backstop
 
     def _check_body_matches_url(self, obj, name: str, ns: str):
         """The reference apiserver rejects name/namespace mismatches between

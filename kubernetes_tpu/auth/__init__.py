@@ -1,0 +1,24 @@
+"""Authentication and authorization.
+
+Parity target: reference pkg/auth/ + pkg/apiserver/authenticator +
+plugin/pkg/auth/ (SURVEY §2.3): request authenticators (bearer token file,
+basic auth file, union, anonymous) and authorizers (always-allow, always-deny,
+ABAC policy file, RBAC over the rbac API group, union).
+"""
+
+from kubernetes_tpu.auth.user import UserInfo  # noqa: F401
+from kubernetes_tpu.auth.authenticators import (  # noqa: F401
+    AnonymousAuthenticator,
+    AuthenticationError,
+    BasicAuthenticator,
+    TokenAuthenticator,
+    UnionAuthenticator,
+)
+from kubernetes_tpu.auth.authorizers import (  # noqa: F401
+    ABACAuthorizer,
+    AlwaysAllow,
+    AlwaysDeny,
+    AuthzAttributes,
+    RBACAuthorizer,
+    UnionAuthorizer,
+)

@@ -1,0 +1,123 @@
+"""Request authenticators.
+
+Parity target: reference pkg/apiserver/authenticator/authn.go — the assembled
+chain tries bearer-token then basic auth; plugin/pkg/auth/authenticator/
+token/tokenfile (CSV: token,user,uid[,groups]) and password/passwordfile
+(CSV: password,user,uid[,groups]). Unauthenticated requests fall through to
+the anonymous identity when allowed.
+"""
+
+from __future__ import annotations
+
+import base64
+import csv
+import io
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.auth import user as userpkg
+from kubernetes_tpu.auth.user import UserInfo
+
+
+class AuthenticationError(Exception):
+    """401 Unauthorized."""
+
+
+def _parse_rows(text: str):
+    """Yield (secret, UserInfo) from the reference's CSV format:
+    secret,user,uid[,group1|group2]."""
+    for row in csv.reader(io.StringIO(text)):
+        if not row or row[0].startswith("#"):
+            continue
+        if len(row) < 3:
+            raise ValueError(f"auth file row needs >=3 columns: {row}")
+        secret, name, uid = row[0].strip(), row[1].strip(), row[2].strip()
+        groups = [g.strip() for g in row[3].split("|")] if len(row) > 3 and row[3] else []
+        yield secret, UserInfo(name=name, uid=uid, groups=groups)
+
+
+def _parse_csv(text: str) -> Dict[str, UserInfo]:
+    """token -> UserInfo (tokens are unique per identity)."""
+    return dict(_parse_rows(text))
+
+
+class TokenAuthenticator:
+    """Authorization: Bearer <token> against a token table."""
+
+    def __init__(self, tokens: Dict[str, UserInfo]):
+        self.tokens = tokens
+
+    @classmethod
+    def from_csv(cls, text: str) -> "TokenAuthenticator":
+        return cls(_parse_csv(text))
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return None
+        token = auth[len("Bearer "):].strip()
+        info = self.tokens.get(token)
+        if info is None:
+            raise AuthenticationError("invalid bearer token")
+        return _with_authenticated(info)
+
+
+class BasicAuthenticator:
+    """Authorization: Basic base64(user:password), looked up by username so
+    two users may share a password (reference passwordfile keys on username)."""
+
+    def __init__(self, users: Dict[str, tuple]):
+        # username -> (password, UserInfo)
+        self.users = users
+
+    @classmethod
+    def from_csv(cls, text: str) -> "BasicAuthenticator":
+        # CSV rows are password,user,uid[,groups] (reference layout)
+        by_user: Dict[str, tuple] = {}
+        for password, info in _parse_rows(text):
+            by_user[info.name] = (password, info)
+        return cls(by_user)
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Basic "):
+            return None
+        try:
+            decoded = base64.b64decode(auth[len("Basic "):]).decode()
+            username, _, password = decoded.partition(":")
+        except Exception:
+            raise AuthenticationError("malformed basic auth header") from None
+        entry = self.users.get(username)
+        if entry is None or entry[0] != password:
+            raise AuthenticationError("invalid username/password")
+        return _with_authenticated(entry[1])
+
+
+class AnonymousAuthenticator:
+    """Always succeeds with the anonymous identity."""
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        return UserInfo(name=userpkg.ANONYMOUS,
+                        groups=[userpkg.ALL_UNAUTHENTICATED])
+
+
+class UnionAuthenticator:
+    """First authenticator that recognizes the request wins; a recognizing
+    authenticator that rejects fails the request (reference union.New)."""
+
+    def __init__(self, authenticators: List):
+        self.authenticators = authenticators
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        for a in self.authenticators:
+            info = a.authenticate(headers)
+            if info is not None:
+                return info
+        raise AuthenticationError("no authenticator recognized the request")
+
+
+def _with_authenticated(info: UserInfo) -> UserInfo:
+    groups = list(info.groups)
+    if userpkg.ALL_AUTHENTICATED not in groups:
+        groups.append(userpkg.ALL_AUTHENTICATED)
+    return UserInfo(name=info.name, uid=info.uid, groups=groups,
+                    extra=dict(info.extra))

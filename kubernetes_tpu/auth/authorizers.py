@@ -1,0 +1,188 @@
+"""Authorizers.
+
+Parity target: reference pkg/auth/authorizer (Attributes), pkg/auth/
+authorizer/abac (line-delimited JSON policy file), plugin/pkg/auth/authorizer/
+rbac (Roles/RoleBindings/ClusterRoles/ClusterRoleBindings resolved per
+request), and the union/always-allow/always-deny composition in
+cmd/kube-apiserver/app/server.go NewAuthorizerFromAuthorizationConfig.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import List, Optional
+
+from kubernetes_tpu.auth.user import UserInfo
+
+
+@dataclass
+class AuthzAttributes:
+    """Reference authorizer.AttributesRecord."""
+
+    user: Optional[UserInfo] = None
+    verb: str = ""            # get/list/watch/create/update/delete
+    resource: str = ""        # plural
+    subresource: str = ""
+    namespace: str = ""
+    api_group: str = ""
+    name: str = ""
+    resource_request: bool = True
+    path: str = ""            # for non-resource requests
+
+
+class Forbidden(Exception):
+    """403."""
+
+
+class AlwaysAllow:
+    def authorize(self, attrs: AuthzAttributes) -> bool:
+        return True
+
+
+class AlwaysDeny:
+    def authorize(self, attrs: AuthzAttributes) -> bool:
+        return False
+
+
+class UnionAuthorizer:
+    """Any authorizer allowing is enough (reference union.New)."""
+
+    def __init__(self, authorizers: List):
+        self.authorizers = authorizers
+
+    def authorize(self, attrs: AuthzAttributes) -> bool:
+        return any(a.authorize(attrs) for a in self.authorizers)
+
+
+class ABACAuthorizer:
+    """Line-delimited JSON policy file. Accepts both the v0 flat form
+    {"user","readonly","resource","namespace"} and the v1beta1 form
+    {"kind":"Policy","spec":{...}} (reference pkg/auth/authorizer/abac)."""
+
+    def __init__(self, policies: List[dict]):
+        self.policies = policies
+
+    @classmethod
+    def from_file_text(cls, text: str) -> "ABACAuthorizer":
+        policies = []
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            d = json.loads(ln)
+            policies.append(d.get("spec", d))
+        return cls(policies)
+
+    def authorize(self, attrs: AuthzAttributes) -> bool:
+        for p in self.policies:
+            if self._matches(p, attrs):
+                return True
+        return False
+
+    @staticmethod
+    def _matches(p: dict, attrs: AuthzAttributes) -> bool:
+        user = attrs.user or UserInfo()
+        pu, pg = p.get("user", ""), p.get("group", "")
+        if pu and pu != "*" and pu != user.name:
+            return False
+        if pg and pg != "*" and pg not in user.groups:
+            return False
+        if not pu and not pg:
+            return False
+        if p.get("readonly") and attrs.verb not in ("get", "list", "watch"):
+            return False
+        if attrs.resource_request:
+            pr = p.get("resource", "")
+            if pr and pr != "*" and pr != attrs.resource:
+                return False
+            pn = p.get("namespace", "")
+            if pn and pn != "*" and pn != attrs.namespace:
+                return False
+            pag = p.get("apiGroup", "")
+            if pag and pag != "*" and pag != attrs.api_group:
+                return False
+        else:
+            path = p.get("nonResourcePath", "")
+            if path and path != "*":
+                if path.endswith("*"):
+                    if not attrs.path.startswith(path[:-1]):
+                        return False
+                elif path != attrs.path:
+                    return False
+        return True
+
+
+class RBACAuthorizer:
+    """Resolves the requesting user's roles from RoleBindings in the request
+    namespace plus ClusterRoleBindings, then matches PolicyRules (reference
+    plugin/pkg/auth/authorizer/rbac/rbac.go authorizingVisitor)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def authorize(self, attrs: AuthzAttributes) -> bool:
+        user = attrs.user or UserInfo()
+        for rules in self._rules_for(user, attrs.namespace):
+            for rule in rules:
+                if self._rule_allows(rule, attrs):
+                    return True
+        return False
+
+    def _rules_for(self, user: UserInfo, namespace: str):
+        from kubernetes_tpu.apis import rbac as rbacapi
+        from kubernetes_tpu.registry.generic import RegistryError
+
+        def subject_matches(s):
+            if s.kind == rbacapi.USER_KIND:
+                return s.name in ("*", user.name)
+            if s.kind == rbacapi.GROUP_KIND:
+                return s.name in user.groups
+            if s.kind == rbacapi.SERVICE_ACCOUNT_KIND:
+                return user.name == f"system:serviceaccount:{s.namespace}:{s.name}"
+            return False
+
+        bindings = []
+        try:
+            items, _ = self.registry.list("clusterrolebindings")
+            bindings += [(b, "") for b in items]
+        except RegistryError:
+            pass
+        if namespace:
+            try:
+                items, _ = self.registry.list("rolebindings", namespace)
+                bindings += [(b, namespace) for b in items]
+            except RegistryError:
+                pass
+        for b, ns in bindings:
+            if not any(subject_matches(s) for s in (b.subjects or [])):
+                continue
+            ref = b.role_ref
+            if ref is None:
+                continue
+            try:
+                if ref.kind == "ClusterRole" or not ns:
+                    role = self.registry.get("clusterroles", ref.name)
+                else:
+                    role = self.registry.get("roles", ref.name, ns)
+            except RegistryError:
+                continue
+            yield role.rules or []
+
+    @staticmethod
+    def _rule_allows(rule, attrs: AuthzAttributes) -> bool:
+        def has(values, want):
+            vals = values or []
+            return "*" in vals or want in vals
+        if not attrs.resource_request:
+            return has(rule.non_resource_urls, attrs.path) and has(rule.verbs, attrs.verb)
+        if not has(rule.verbs, attrs.verb):
+            return False
+        if not has(rule.resources, attrs.resource):
+            return False
+        groups = rule.api_groups if rule.api_groups is not None else [""]
+        if "*" not in groups and attrs.api_group not in groups:
+            return False
+        if rule.resource_names:
+            return attrs.name in rule.resource_names
+        return True

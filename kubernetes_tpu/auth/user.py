@@ -1,0 +1,19 @@
+"""User identity (reference pkg/auth/user/user.go user.Info)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+# well-known groups (reference pkg/auth/user)
+ALL_AUTHENTICATED = "system:authenticated"
+ALL_UNAUTHENTICATED = "system:unauthenticated"
+ANONYMOUS = "system:anonymous"
+
+
+@dataclass
+class UserInfo:
+    name: str = ""
+    uid: str = ""
+    groups: List[str] = field(default_factory=list)
+    extra: Dict[str, List[str]] = field(default_factory=dict)

@@ -93,11 +93,14 @@ class RESTClient:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8080,
                  qps: float = 50.0, burst: int = 100,
-                 user_agent: str = "kubernetes-tpu-client", timeout: float = 30.0):
+                 user_agent: str = "kubernetes-tpu-client", timeout: float = 30.0,
+                 bearer_token: str = "", basic_auth: Optional[tuple] = None):
         self.host = host
         self.port = port
         self.timeout = timeout
         self.user_agent = user_agent
+        self.bearer_token = bearer_token
+        self.basic_auth = basic_auth  # (user, password)
         self._limiter = TokenBucket(qps, burst)
         self._local = threading.local()
 
@@ -131,6 +134,7 @@ class RESTClient:
         headers = {"User-Agent": self.user_agent}
         if payload is not None:
             headers["Content-Type"] = "application/json"
+        self._auth_headers(headers)
         for attempt in (1, 2):
             conn = self._conn()
             try:
@@ -159,16 +163,30 @@ class RESTClient:
                            parsed.get("message", ""))
         return parsed
 
+    def _auth_headers(self, headers: dict) -> None:
+        if self.bearer_token:
+            headers["Authorization"] = f"Bearer {self.bearer_token}"
+        elif self.basic_auth:
+            import base64
+            cred = base64.b64encode(
+                f"{self.basic_auth[0]}:{self.basic_auth[1]}".encode()).decode()
+            headers["Authorization"] = f"Basic {cred}"
+
     # --- paths ---------------------------------------------------------------
 
     @staticmethod
     def _collection_path(resource: str, namespace: str = "") -> str:
         rd = RESOURCES.get(resource)
+        # group resources live under /apis/<group>/<version> (reference
+        # generated clientsets carry their group in the path the same way)
+        base = "/api/v1"
+        if rd is not None and rd.api_version != "v1":
+            base = f"/apis/{rd.api_version}"
         if rd is not None and not rd.namespaced:
-            return f"/api/v1/{resource}"
+            return f"{base}/{resource}"
         if namespace:
-            return f"/api/v1/namespaces/{namespace}/{resource}"
-        return f"/api/v1/{resource}"
+            return f"{base}/namespaces/{namespace}/{resource}"
+        return f"{base}/{resource}"
 
     def _item_path(self, resource: str, name: str, namespace: str = "") -> str:
         return f"{self._collection_path(resource, namespace)}/{quote(name)}"
@@ -226,6 +244,22 @@ class RESTClient:
         self.request("POST", f"/api/v1/namespaces/{namespace}/bindings",
                      scheme.encode(binding))
 
+    def get_scale(self, resource: str, name: str, namespace: str = ""):
+        from kubernetes_tpu.apis import extensions as ext
+        d = self.request("GET", self._item_path(resource, name, namespace) + "/scale")
+        return from_dict(ext.Scale, d)
+
+    def update_scale(self, resource: str, name: str, namespace: str, scale):
+        from kubernetes_tpu.apis import extensions as ext
+        d = self.request("PUT", self._item_path(resource, name, namespace) + "/scale",
+                         scheme.encode(scale))
+        return from_dict(ext.Scale, d)
+
+    def rollback_deployment(self, name: str, namespace: str, rollback):
+        self.request("POST",
+                     self._item_path("deployments", name, namespace) + "/rollback",
+                     scheme.encode(rollback))
+
     def watch(self, resource: str, namespace: str = "", resource_version=None,
               label_selector=None, field_selector=None) -> WatchStream:
         """Open a streaming watch. Not rate-limited (watches are long-lived;
@@ -234,7 +268,9 @@ class RESTClient:
             label_selector, field_selector, watch="true",
             resourceVersion=resource_version)
         conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout + 35)
-        conn.request("GET", path, headers={"User-Agent": self.user_agent})
+        headers = {"User-Agent": self.user_agent}
+        self._auth_headers(headers)
+        conn.request("GET", path, headers=headers)
         resp = conn.getresponse()
         if resp.status >= 400:
             data = resp.read()

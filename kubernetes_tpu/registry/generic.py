@@ -150,6 +150,61 @@ _register(ResourceDef("persistentvolumes", "PersistentVolume",
                       api.PersistentVolume, namespaced=False))
 _register(ResourceDef("persistentvolumeclaims", "PersistentVolumeClaim",
                       api.PersistentVolumeClaim))
+_register(ResourceDef("secrets", "Secret", api.Secret,
+                      validator=validation.validate_secret))
+_register(ResourceDef("configmaps", "ConfigMap", api.ConfigMap))
+_register(ResourceDef("serviceaccounts", "ServiceAccount", api.ServiceAccount))
+_register(ResourceDef("limitranges", "LimitRange", api.LimitRange,
+                      validator=validation.validate_limit_range))
+_register(ResourceDef("resourcequotas", "ResourceQuota", api.ResourceQuota,
+                      validator=validation.validate_resource_quota))
+
+
+def _register_group_resources():
+    """Resources from the non-core API groups (reference pkg/apis/<g>/install
+    + pkg/registry per-resource packages; SURVEY §2.1/§2.3)."""
+    from kubernetes_tpu.apis import apps, autoscaling, batch, extensions, policy, rbac
+
+    _register(ResourceDef("deployments", "Deployment", extensions.Deployment,
+                          api_version=extensions.GROUP_VERSION,
+                          validator=validation.validate_deployment))
+    _register(ResourceDef("daemonsets", "DaemonSet", extensions.DaemonSet,
+                          api_version=extensions.GROUP_VERSION,
+                          validator=validation.validate_daemonset))
+    _register(ResourceDef("ingresses", "Ingress", extensions.Ingress,
+                          api_version=extensions.GROUP_VERSION,
+                          list_kind="IngressList"))
+    _register(ResourceDef("thirdpartyresources", "ThirdPartyResource",
+                          extensions.ThirdPartyResource, namespaced=False,
+                          api_version=extensions.GROUP_VERSION))
+    _register(ResourceDef("jobs", "Job", batch.Job,
+                          api_version=batch.GROUP_VERSION,
+                          validator=validation.validate_job))
+    _register(ResourceDef("scheduledjobs", "ScheduledJob", batch.ScheduledJob,
+                          api_version=batch.GROUP_VERSION_V2,
+                          validator=validation.validate_scheduled_job))
+    _register(ResourceDef("horizontalpodautoscalers", "HorizontalPodAutoscaler",
+                          autoscaling.HorizontalPodAutoscaler,
+                          api_version=autoscaling.GROUP_VERSION,
+                          validator=validation.validate_hpa))
+    _register(ResourceDef("petsets", "PetSet", apps.PetSet,
+                          api_version=apps.GROUP_VERSION,
+                          validator=validation.validate_petset))
+    _register(ResourceDef("poddisruptionbudgets", "PodDisruptionBudget",
+                          policy.PodDisruptionBudget,
+                          api_version=policy.GROUP_VERSION))
+    _register(ResourceDef("roles", "Role", rbac.Role,
+                          api_version=rbac.GROUP_VERSION))
+    _register(ResourceDef("rolebindings", "RoleBinding", rbac.RoleBinding,
+                          api_version=rbac.GROUP_VERSION))
+    _register(ResourceDef("clusterroles", "ClusterRole", rbac.ClusterRole,
+                          namespaced=False, api_version=rbac.GROUP_VERSION))
+    _register(ResourceDef("clusterrolebindings", "ClusterRoleBinding",
+                          rbac.ClusterRoleBinding, namespaced=False,
+                          api_version=rbac.GROUP_VERSION))
+
+
+_register_group_resources()
 
 
 class Registry:
@@ -309,6 +364,78 @@ class Registry:
             return pod
 
         self.guaranteed_update("pods", pod_name, namespace, assign)
+
+    # scale subresource (reference extensions Scale registry; kubectl scale
+    # and the HPA controller go through this)
+    SCALABLE = {"replicationcontrollers", "replicasets", "deployments", "petsets"}
+
+    def get_scale(self, resource: str, name: str, namespace: str = ""):
+        from kubernetes_tpu.apis import extensions as ext
+        if resource not in self.SCALABLE:
+            raise bad_request(f"resource {resource!r} has no scale subresource")
+        obj = self.get(resource, name, namespace)
+        return self._scale_view(obj, ext)
+
+    def update_scale(self, resource: str, name: str, namespace: str, scale):
+        from kubernetes_tpu.apis import extensions as ext
+        if resource not in self.SCALABLE:
+            raise bad_request(f"resource {resource!r} has no scale subresource")
+        want = scale.spec.replicas if scale.spec else 0
+        expect_rv = scale.metadata.resource_version if scale.metadata else ""
+
+        if scale.spec is None:
+            raise invalid("spec: required")
+        if not isinstance(want, int) or want < 0:
+            raise invalid("spec.replicas: must be a non-negative integer")
+        rd = self._def(resource)
+
+        def set_replicas(cur):
+            # optimistic concurrency: a stale Scale must 409, not clobber a
+            # concurrent scaling (reference Scale storage honors the RV)
+            if expect_rv and cur.metadata.resource_version != expect_rv:
+                raise conflict(resource, name,
+                               f"scale rv {expect_rv} != current "
+                               f"{cur.metadata.resource_version}")
+            if cur.spec is None:
+                raise invalid("spec: required")
+            cur.spec.replicas = want
+            if rd.validator:
+                try:
+                    rd.validator(cur)
+                except validation.ValidationError as e:
+                    raise invalid(str(e)) from None
+            return cur
+
+        obj = self.guaranteed_update(resource, name, namespace, set_replicas)
+        return self._scale_view(obj, ext)
+
+    @staticmethod
+    def _scale_view(obj, ext):
+        sel = obj.spec.selector if obj.spec else None
+        if isinstance(sel, api.LabelSelector):
+            sel = sel.match_labels
+        return ext.Scale(
+            metadata=api.ObjectMeta(name=obj.metadata.name,
+                                    namespace=obj.metadata.namespace,
+                                    resource_version=obj.metadata.resource_version),
+            spec=ext.ScaleSpec(replicas=(obj.spec.replicas or 0) if obj.spec else 0),
+            status=ext.ScaleStatus(
+                replicas=(obj.status.replicas if obj.status else 0) or 0,
+                selector=sel))
+
+    def rollback_deployment(self, name: str, namespace: str, rollback) -> None:
+        """POST /deployments/{name}/rollback — records spec.rollbackTo for the
+        deployment controller to act on (reference extensions
+        DeploymentRollback storage)."""
+        from kubernetes_tpu.apis import extensions as ext
+
+        def set_rollback(d):
+            if d.spec is None:
+                raise invalid("spec: required")
+            d.spec.rollback_to = rollback.rollback_to or ext.RollbackConfig(revision=0)
+            return d
+
+        self.guaranteed_update("deployments", name, namespace, set_rollback)
 
     def update_status(self, resource: str, obj, namespace: str = ""):
         """PUT /{resource}/{name}/status — replaces only .status."""

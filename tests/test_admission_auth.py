@@ -1,0 +1,304 @@
+"""Admission chain + authn/authz coverage (reference pkg/admission,
+plugin/pkg/admission/*, pkg/auth, plugin/pkg/auth)."""
+
+import pytest
+
+from kubernetes_tpu.admission import AdmissionError, Attributes, new_chain
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.auth import (
+    ABACAuthorizer, AuthzAttributes, BasicAuthenticator, RBACAuthorizer,
+    TokenAuthenticator, UnionAuthenticator, UserInfo,
+)
+from kubernetes_tpu.apis import rbac
+from kubernetes_tpu.client.rest import ApiError, RESTClient
+from kubernetes_tpu.registry.generic import Registry
+
+
+def _pod(name, ns="default", cpu=None, privileged=False, **meta):
+    sc = api.SecurityContext(privileged=True) if privileged else None
+    res = (api.ResourceRequirements(requests={"cpu": cpu, "memory": "64Mi"})
+           if cpu else None)
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, **meta),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img", resources=res, security_context=sc)]))
+
+
+class TestNamespacePlugins:
+    def test_lifecycle_rejects_missing_and_terminating(self):
+        reg = Registry()
+        chain = new_chain(["NamespaceLifecycle"], registry=reg)
+        with pytest.raises(AdmissionError):
+            chain.admit(Attributes(resource="pods", namespace="nope",
+                                   operation="CREATE", obj=_pod("p", "nope")))
+        reg.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="dying"),
+            status=api.NamespaceStatus(phase="Terminating")))
+        with pytest.raises(AdmissionError) as e:
+            chain.admit(Attributes(resource="pods", namespace="dying",
+                                   operation="CREATE", obj=_pod("p", "dying")))
+        assert "terminating" in str(e.value)
+        with pytest.raises(AdmissionError):
+            chain.admit(Attributes(resource="namespaces", name="default",
+                                   operation="DELETE"))
+
+    def test_autoprovision_creates_namespace(self):
+        reg = Registry()
+        chain = new_chain(["NamespaceAutoProvision"], registry=reg)
+        chain.admit(Attributes(resource="pods", namespace="fresh",
+                               operation="CREATE", obj=_pod("p", "fresh")))
+        assert reg.get("namespaces", "fresh").metadata.name == "fresh"
+
+
+class TestLimitRanger:
+    def test_defaults_and_max(self):
+        reg = Registry()
+        reg.create("limitranges", api.LimitRange(
+            metadata=api.ObjectMeta(name="lr", namespace="default"),
+            spec=api.LimitRangeSpec(limits=[api.LimitRangeItem(
+                type="Container",
+                default_request={"cpu": "100m", "memory": "64Mi"},
+                max={"cpu": "2"})])), namespace="default")
+        chain = new_chain(["LimitRanger"], registry=reg)
+        pod = _pod("p")
+        chain.admit(Attributes(resource="pods", namespace="default",
+                               operation="CREATE", obj=pod))
+        assert pod.spec.containers[0].resources.requests["cpu"] == "100m"
+        big = _pod("big", cpu="4")
+        with pytest.raises(AdmissionError) as e:
+            chain.admit(Attributes(resource="pods", namespace="default",
+                                   operation="CREATE", obj=big))
+        assert "maximum cpu" in str(e.value)
+
+
+class TestResourceQuota:
+    def test_books_usage_and_rejects_over_quota(self):
+        reg = Registry()
+        reg.create("resourcequotas", api.ResourceQuota(
+            metadata=api.ObjectMeta(name="q", namespace="default"),
+            spec=api.ResourceQuotaSpec(hard={"pods": "2", "cpu": "1"})),
+            namespace="default")
+        chain = new_chain(["ResourceQuota"], registry=reg)
+        chain.admit(Attributes(resource="pods", namespace="default",
+                               operation="CREATE", obj=_pod("a", cpu="600m")))
+        q = reg.get("resourcequotas", "q", "default")
+        assert q.status.used["pods"] == "1"
+        assert q.status.used["cpu"] == "600m"
+        with pytest.raises(AdmissionError) as e:
+            chain.admit(Attributes(resource="pods", namespace="default",
+                                   operation="CREATE", obj=_pod("b", cpu="600m")))
+        assert "exceeded quota" in str(e.value)
+        # pod without cpu request still counts against pods
+        chain.admit(Attributes(resource="pods", namespace="default",
+                               operation="CREATE", obj=_pod("c")))
+        with pytest.raises(AdmissionError):
+            chain.admit(Attributes(resource="pods", namespace="default",
+                                   operation="CREATE", obj=_pod("d")))
+
+
+class TestPolicyPlugins:
+    def test_security_context_deny(self):
+        chain = new_chain(["SecurityContextDeny"])
+        with pytest.raises(AdmissionError):
+            chain.admit(Attributes(resource="pods", namespace="default",
+                                   operation="CREATE",
+                                   obj=_pod("p", privileged=True)))
+
+    def test_always_pull_and_service_account_defaults(self):
+        reg = Registry()
+        chain = new_chain(["ServiceAccount", "AlwaysPullImages"], registry=reg)
+        pod = _pod("p")
+        chain.admit(Attributes(resource="pods", namespace="default",
+                               operation="CREATE", obj=pod))
+        assert pod.spec.service_account_name == "default"
+        assert pod.spec.containers[0].image_pull_policy == "Always"
+
+    def test_anti_affinity_limit(self):
+        chain = new_chain(["LimitPodHardAntiAffinityTopology"])
+        pod = _pod("p")
+        pod.spec.affinity = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(topology_key=api.LABEL_ZONE)]))
+        with pytest.raises(AdmissionError):
+            chain.admit(Attributes(resource="pods", namespace="default",
+                                   operation="CREATE", obj=pod))
+
+
+class TestAdmissionOverHTTP:
+    def test_quota_enforced_end_to_end(self):
+        server = APIServer(admission_control=["ResourceQuota"]).start()
+        try:
+            c = RESTClient.for_server(server)
+            server.registry.create("resourcequotas", api.ResourceQuota(
+                metadata=api.ObjectMeta(name="q", namespace="default"),
+                spec=api.ResourceQuotaSpec(hard={"pods": "1"})),
+                namespace="default")
+            c.create("pods", _pod("one"), namespace="default")
+            with pytest.raises(ApiError) as e:
+                c.create("pods", _pod("two"), namespace="default")
+            assert e.value.code == 403
+        finally:
+            server.stop()
+
+
+class TestReviewRegressions:
+    def test_quota_released_on_delete(self):
+        server = APIServer(admission_control=["ResourceQuota"]).start()
+        try:
+            c = RESTClient.for_server(server)
+            server.registry.create("resourcequotas", api.ResourceQuota(
+                metadata=api.ObjectMeta(name="q", namespace="default"),
+                spec=api.ResourceQuotaSpec(hard={"pods": "1"})),
+                namespace="default")
+            for _ in range(3):  # create/delete cycles must not leak usage
+                c.create("pods", _pod("cycle"), namespace="default")
+                c.delete("pods", "cycle", namespace="default")
+            q = server.registry.get("resourcequotas", "q", "default")
+            assert q.status.used["pods"] == "0"
+        finally:
+            server.stop()
+
+    def test_delete_on_scale_subresource_is_405(self):
+        server = APIServer().start()
+        try:
+            c = RESTClient.for_server(server)
+            server.registry.create("replicationcontrollers",
+                                   api.ReplicationController(
+                metadata=api.ObjectMeta(name="rc", namespace="default"),
+                spec=api.ReplicationControllerSpec(
+                    replicas=1, selector={"a": "b"},
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"a": "b"}),
+                        spec=api.PodSpec(containers=[
+                            api.Container(name="c", image="i")])))),
+                namespace="default")
+            with pytest.raises(ApiError) as e:
+                c.request("DELETE",
+                          "/api/v1/namespaces/default/replicationcontrollers/rc/scale")
+            assert e.value.code == 405
+            # the parent object must survive the probe
+            assert c.get("replicationcontrollers", "rc", "default")
+        finally:
+            server.stop()
+
+    def test_stale_scale_put_conflicts(self):
+        from kubernetes_tpu.apis import extensions as ext
+        reg = Registry()
+        reg.create("replicationcontrollers", api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=1, selector={"a": "b"},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"a": "b"}),
+                    spec=api.PodSpec(containers=[
+                        api.Container(name="c", image="i")])))),
+            namespace="default")
+        stale = reg.get_scale("replicationcontrollers", "rc", "default")
+        fresh = reg.get_scale("replicationcontrollers", "rc", "default")
+        fresh.spec.replicas = 10
+        reg.update_scale("replicationcontrollers", "rc", "default", fresh)
+        stale.spec.replicas = 4
+        from kubernetes_tpu.registry.generic import RegistryError
+        with pytest.raises(RegistryError) as e:
+            reg.update_scale("replicationcontrollers", "rc", "default", stale)
+        assert e.value.code == 409
+
+    def test_basic_auth_shared_password(self):
+        b = BasicAuthenticator.from_csv("pw,alice,1\npw,bob,2\n")
+        import base64
+        for user in ("alice", "bob"):
+            cred = base64.b64encode(f"{user}:pw".encode()).decode()
+            assert b.authenticate({"Authorization": f"Basic {cred}"}).name == user
+
+    def test_status_update_skips_admission(self):
+        server = APIServer(admission_control=["LimitRanger"]).start()
+        try:
+            c = RESTClient.for_server(server)
+            pod = c.create("pods", _pod("p"), namespace="default")
+            # now add a LimitRange with a min that the existing pod violates
+            server.registry.create("limitranges", api.LimitRange(
+                metadata=api.ObjectMeta(name="lr", namespace="default"),
+                spec=api.LimitRangeSpec(limits=[api.LimitRangeItem(
+                    type="Container", min={"cpu": "500m"})])),
+                namespace="default")
+            pod.status = api.PodStatus(phase="Running")
+            updated = c.update_status("pods", pod, namespace="default")
+            assert updated.status.phase == "Running"
+        finally:
+            server.stop()
+
+
+class TestAuthenticators:
+    def test_token_and_basic_union(self):
+        tok = TokenAuthenticator.from_csv("s3cret,alice,1,admins|devs\n")
+        basic = BasicAuthenticator.from_csv("pw,bob,2\n")
+        union = UnionAuthenticator([tok, basic])
+        info = union.authenticate({"Authorization": "Bearer s3cret"})
+        assert info.name == "alice" and "admins" in info.groups
+        assert "system:authenticated" in info.groups
+        import base64
+        cred = base64.b64encode(b"bob:pw").decode()
+        assert union.authenticate({"Authorization": f"Basic {cred}"}).name == "bob"
+
+
+class TestAuthorizers:
+    def test_abac(self):
+        authz = ABACAuthorizer.from_file_text(
+            '{"user":"alice","resource":"*","namespace":"*"}\n'
+            '{"kind":"Policy","spec":{"user":"bob","readonly":true,"resource":"pods"}}\n')
+        alice = UserInfo(name="alice")
+        bob = UserInfo(name="bob")
+        assert authz.authorize(AuthzAttributes(user=alice, verb="create",
+                                               resource="pods", namespace="x"))
+        assert authz.authorize(AuthzAttributes(user=bob, verb="get",
+                                               resource="pods"))
+        assert not authz.authorize(AuthzAttributes(user=bob, verb="create",
+                                                   resource="pods"))
+
+    def test_rbac(self):
+        reg = Registry()
+        reg.create("clusterroles", rbac.ClusterRole(
+            metadata=api.ObjectMeta(name="pod-reader"),
+            rules=[rbac.PolicyRule(verbs=["get", "list"], resources=["pods"],
+                                   api_groups=[""])]))
+        reg.create("clusterrolebindings", rbac.ClusterRoleBinding(
+            metadata=api.ObjectMeta(name="read-pods"),
+            subjects=[rbac.Subject(kind="User", name="carol")],
+            role_ref=api.ObjectReference(kind="ClusterRole", name="pod-reader")))
+        authz = RBACAuthorizer(reg)
+        carol = UserInfo(name="carol")
+        assert authz.authorize(AuthzAttributes(user=carol, verb="list",
+                                               resource="pods", namespace="default"))
+        assert not authz.authorize(AuthzAttributes(user=carol, verb="create",
+                                                   resource="pods"))
+        assert not authz.authorize(AuthzAttributes(user=UserInfo(name="eve"),
+                                                   verb="list", resource="pods"))
+
+
+class TestAuthOverHTTP:
+    def test_secure_server_requires_token_and_authorizes(self):
+        reg = Registry()
+        authn = TokenAuthenticator.from_csv("tik,alice,1\nrok,bob,2\n")
+        authz = ABACAuthorizer.from_file_text(
+            '{"user":"alice","resource":"*","namespace":"*"}\n'
+            '{"user":"bob","readonly":true,"resource":"pods","namespace":"*"}\n')
+        server = APIServer(registry=reg, authenticator=authn,
+                           authorizer=authz).start()
+        try:
+            anon = RESTClient.for_server(server)
+            with pytest.raises(ApiError) as e:
+                anon.list("pods", "default")
+            assert e.value.code == 401
+
+            alice = RESTClient.for_server(server, bearer_token="tik")
+            alice.create("pods", _pod("p1"), namespace="default")
+
+            bob = RESTClient.for_server(server, bearer_token="rok")
+            pods, _ = bob.list("pods", "default")
+            assert len(pods) == 1
+            with pytest.raises(ApiError) as e:
+                bob.create("pods", _pod("p2"), namespace="default")
+            assert e.value.code == 403
+        finally:
+            server.stop()

@@ -1,0 +1,196 @@
+"""API group coverage: extensions/batch/autoscaling/apps/policy/rbac types,
+group routing under /apis/<group>/<version>, and the scale/rollback
+subresources (reference pkg/apis/* + extensions Scale registry)."""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import from_dict, scheme, to_dict
+from kubernetes_tpu.apis import apps, autoscaling, batch, extensions as ext, policy, rbac
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import ApiError, RESTClient
+from kubernetes_tpu.registry.generic import Registry, RegistryError
+
+
+def _tpl(labels):
+    return api.PodTemplateSpec(
+        metadata=api.ObjectMeta(labels=dict(labels)),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="pause")],
+                         restart_policy="Never"))
+
+
+def _deployment(name="web", replicas=3):
+    return ext.Deployment(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=ext.DeploymentSpec(
+            replicas=replicas,
+            selector=api.LabelSelector(match_labels={"app": name}),
+            template=_tpl({"app": name}),
+            strategy=ext.DeploymentStrategy(
+                type=ext.ROLLING_UPDATE,
+                rolling_update=ext.RollingUpdateDeployment(
+                    max_unavailable=1, max_surge="25%"))))
+
+
+class TestSchemeRoundTrip:
+    @pytest.mark.parametrize("obj,gv,kind", [
+        (_deployment(), "extensions/v1beta1", "Deployment"),
+        (ext.DaemonSet(metadata=api.ObjectMeta(name="d"),
+                       spec=ext.DaemonSetSpec(template=_tpl({"a": "b"}))),
+         "extensions/v1beta1", "DaemonSet"),
+        (ext.Ingress(metadata=api.ObjectMeta(name="i"),
+                     spec=ext.IngressSpec(rules=[ext.IngressRule(
+                         host="x.test", http=ext.HTTPIngressRuleValue(paths=[
+                             ext.HTTPIngressPath(path="/", backend=ext.IngressBackend(
+                                 service_name="s", service_port=80))]))])),
+         "extensions/v1beta1", "Ingress"),
+        (batch.Job(metadata=api.ObjectMeta(name="j"),
+                   spec=batch.JobSpec(completions=2, parallelism=2,
+                                      template=_tpl({"job": "j"}))),
+         "batch/v1", "Job"),
+        (batch.ScheduledJob(metadata=api.ObjectMeta(name="sj"),
+                            spec=batch.ScheduledJobSpec(
+                                schedule="*/5 * * * *",
+                                job_template=batch.JobTemplateSpec(
+                                    spec=batch.JobSpec(template=_tpl({"x": "y"}))))),
+         "batch/v2alpha1", "ScheduledJob"),
+        (autoscaling.HorizontalPodAutoscaler(
+            metadata=api.ObjectMeta(name="h"),
+            spec=autoscaling.HorizontalPodAutoscalerSpec(
+                scale_target_ref=autoscaling.CrossVersionObjectReference(
+                    kind="ReplicationController", name="rc"),
+                min_replicas=1, max_replicas=10,
+                target_cpu_utilization_percentage=80)),
+         "autoscaling/v1", "HorizontalPodAutoscaler"),
+        (apps.PetSet(metadata=api.ObjectMeta(name="p"),
+                     spec=apps.PetSetSpec(replicas=2, service_name="svc",
+                                          template=_tpl({"p": "s"}))),
+         "apps/v1alpha1", "PetSet"),
+        (policy.PodDisruptionBudget(
+            metadata=api.ObjectMeta(name="b"),
+            spec=policy.PodDisruptionBudgetSpec(min_available="50%")),
+         "policy/v1alpha1", "PodDisruptionBudget"),
+        (rbac.ClusterRole(metadata=api.ObjectMeta(name="admin"),
+                          rules=[rbac.PolicyRule(verbs=["*"], api_groups=["*"],
+                                                 resources=["*"])]),
+         "rbac.authorization.k8s.io/v1alpha1", "ClusterRole"),
+    ])
+    def test_round_trip(self, obj, gv, kind):
+        d = scheme.encode(obj)
+        assert d["apiVersion"] == gv and d["kind"] == kind
+        back = scheme.decode(d)
+        assert to_dict(back) == to_dict(obj)
+
+    def test_camel_case_wire_names(self):
+        d = to_dict(_deployment())
+        assert "rollingUpdate" in d["spec"]["strategy"]
+        assert d["spec"]["strategy"]["rollingUpdate"]["maxSurge"] == "25%"
+
+    def test_core_additions_round_trip(self):
+        s = api.Secret(metadata=api.ObjectMeta(name="tok", namespace="default"),
+                       data={"token": "YWJj"},
+                       type=api.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN)
+        assert scheme.encode(s)["apiVersion"] == "v1"
+        rq = api.ResourceQuota(
+            metadata=api.ObjectMeta(name="q"),
+            spec=api.ResourceQuotaSpec(hard={"cpu": "10", "pods": "20"}))
+        back = from_dict(api.ResourceQuota, to_dict(rq))
+        assert back.spec.hard == {"cpu": "10", "pods": "20"}
+
+
+class TestGroupRegistry:
+    def test_crud_each_group_resource(self):
+        reg = Registry()
+        reg.create("deployments", _deployment(), namespace="default")
+        got = reg.get("deployments", "web", "default")
+        assert got.spec.replicas == 3
+        items, _ = reg.list("deployments", "default")
+        assert len(items) == 1
+
+        reg.create("clusterroles", rbac.ClusterRole(
+            metadata=api.ObjectMeta(name="view"),
+            rules=[rbac.PolicyRule(verbs=["get", "list"], resources=["pods"])]))
+        assert reg.get("clusterroles", "view").rules[0].verbs == ["get", "list"]
+
+    def test_validation_rejects_bad_objects(self):
+        reg = Registry()
+        with pytest.raises(RegistryError) as e:
+            reg.create("jobs", batch.Job(
+                metadata=api.ObjectMeta(name="j", namespace="default"),
+                spec=batch.JobSpec(parallelism=-1, template=_tpl({}))))
+        assert e.value.code == 422
+        with pytest.raises(RegistryError):
+            reg.create("horizontalpodautoscalers", autoscaling.HorizontalPodAutoscaler(
+                metadata=api.ObjectMeta(name="h", namespace="default"),
+                spec=autoscaling.HorizontalPodAutoscalerSpec(max_replicas=0)))
+        with pytest.raises(RegistryError):
+            reg.create("scheduledjobs", batch.ScheduledJob(
+                metadata=api.ObjectMeta(name="s", namespace="default"),
+                spec=batch.ScheduledJobSpec(schedule="bogus",
+                                            job_template=batch.JobTemplateSpec())))
+
+    def test_scale_subresource(self):
+        reg = Registry()
+        reg.create("deployments", _deployment(), namespace="default")
+        sc = reg.get_scale("deployments", "web", "default")
+        assert sc.spec.replicas == 3
+        assert sc.status.selector == {"app": "web"}
+        sc.spec.replicas = 7
+        out = reg.update_scale("deployments", "web", "default", sc)
+        assert out.spec.replicas == 7
+        assert reg.get("deployments", "web", "default").spec.replicas == 7
+
+    def test_rollback_subresource(self):
+        reg = Registry()
+        reg.create("deployments", _deployment(), namespace="default")
+        reg.rollback_deployment("web", "default", ext.DeploymentRollback(
+            name="web", rollback_to=ext.RollbackConfig(revision=2)))
+        assert reg.get("deployments", "web", "default").spec.rollback_to.revision == 2
+
+
+class TestGroupHTTP:
+    @pytest.fixture()
+    def server(self):
+        s = APIServer()
+        s.start()
+        yield s
+        s.stop()
+
+    def test_group_paths_end_to_end(self, server):
+        c = RESTClient.for_server(server)
+        c.create("deployments", _deployment(), namespace="default")
+        got = c.get("deployments", "web", "default")
+        assert got.spec.replicas == 3
+
+        # scale through HTTP
+        sc = c.get_scale("deployments", "web", "default")
+        sc.spec.replicas = 5
+        assert c.update_scale("deployments", "web", "default", sc).spec.replicas == 5
+
+        # group resources 404 under the core prefix
+        with pytest.raises(ApiError) as e:
+            c.request("GET", "/api/v1/namespaces/default/deployments/web")
+        assert e.value.code == 404
+
+        # non-namespaced group resource
+        c.create("clusterroles", rbac.ClusterRole(
+            metadata=api.ObjectMeta(name="edit"),
+            rules=[rbac.PolicyRule(verbs=["*"], resources=["pods"])]))
+        assert c.get("clusterroles", "edit").metadata.name == "edit"
+
+    def test_discovery_endpoints(self, server):
+        c = RESTClient.for_server(server)
+        assert "v1" in c.request("GET", "/api")["versions"]
+        groups = {g["name"] for g in c.request("GET", "/apis")["groups"]}
+        assert {"extensions", "batch", "autoscaling", "apps", "policy"} <= groups
+
+    def test_watch_group_resource(self, server):
+        c = RESTClient.for_server(server)
+        _, rv = c.list("jobs", "default")
+        w = c.watch("jobs", "default", resource_version=rv)
+        c.create("jobs", batch.Job(
+            metadata=api.ObjectMeta(name="j1", namespace="default"),
+            spec=batch.JobSpec(template=_tpl({"job": "j1"}))), namespace="default")
+        ev_type, obj = next(iter(w))
+        assert ev_type == "ADDED" and obj.metadata.name == "j1"
+        w.stop()
