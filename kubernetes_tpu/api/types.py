@@ -593,6 +593,7 @@ class ReplicaSetSpec:
 class ReplicaSetStatus:
     replicas: int = 0
     fully_labeled_replicas: int = 0
+    ready_replicas: int = 0
     observed_generation: int = 0
 
 

@@ -30,6 +30,9 @@ class Controller:
     def enqueue(self, key: str):
         self.queue.add(key)
 
+    def enqueue_after(self, key: str, delay: float):
+        self.queue.add_after(key, delay)
+
     def sync(self, key: str) -> None:
         raise NotImplementedError
 

@@ -1,0 +1,224 @@
+"""DaemonSet controller: one pod per eligible node.
+
+Parity target: reference pkg/controller/daemon/controller.go — for every node,
+decide nodeShouldRunDaemonPod (node ready, nodeSelector/nodeName match, taints
+tolerated, room per GeneralPredicates), create daemon pods with spec.nodeName
+set directly (this era's daemon pods bypass the scheduler,
+controller.go createPodsOnNode), delete pods from nodes that no longer
+qualify, and keep status {desired,current,misscheduled}NumberScheduled."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.apis import extensions as ext
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.expectations import ControllerExpectations
+from kubernetes_tpu.controllers.pod_control import (
+    is_pod_active, pod_from_template, selector_for,
+)
+from kubernetes_tpu.scheduler.cache import NodeInfo
+from kubernetes_tpu.scheduler.predicates import (
+    PredicateFailure, pod_matches_node_selector, pod_tolerates_node_taints,
+)
+
+log = logging.getLogger("daemonset-controller")
+
+
+class DaemonSetController(Controller):
+    name = "daemonset"
+
+    def __init__(self, client: RESTClient, workers: int = 2):
+        super().__init__(workers)
+        self.client = client
+        self.ds_informer = Informer(ListWatch(client, "daemonsets"))
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.node_informer = Informer(ListWatch(client, "nodes"))
+        self.expectations = ControllerExpectations()
+        self.ds_informer.add_event_handler(
+            on_add=lambda ds: self.enqueue(_key(ds)),
+            on_update=lambda old, new: self.enqueue(_key(new)),
+            on_delete=self._ds_deleted)
+        self.pod_informer.add_event_handler(
+            on_add=self._pod_added,
+            on_update=lambda old, new: self._pod_changed(new),
+            on_delete=self._pod_deleted)
+        # any node change can flip eligibility for every daemon set
+        self.node_informer.add_event_handler(
+            on_add=lambda n: self._all_dirty(),
+            on_update=lambda old, new: self._all_dirty(),
+            on_delete=lambda n: self._all_dirty())
+
+    def _all_dirty(self):
+        for ds in self.ds_informer.store.list():
+            self.enqueue(_key(ds))
+
+    def _ds_deleted(self, ds):
+        self.expectations.delete_expectations(_key(ds))
+        self.enqueue(_key(ds))
+
+    def _pod_added(self, pod):
+        for ds in self._owners_of(pod):
+            self.expectations.creation_observed(_key(ds))
+            self.enqueue(_key(ds))
+
+    def _pod_deleted(self, pod):
+        for ds in self._owners_of(pod):
+            self.expectations.deletion_observed(_key(ds))
+            self.enqueue(_key(ds))
+
+    def _pod_changed(self, pod):
+        for ds in self._owners_of(pod):
+            self.enqueue(_key(ds))
+
+    def _owners_of(self, pod) -> List[ext.DaemonSet]:
+        lbls = pod.metadata.labels or {}
+        return [ds for ds in self.ds_informer.store.list()
+                if ds.metadata.namespace == pod.metadata.namespace
+                and _selector(ds).matches(lbls)]
+
+    # --- eligibility ---------------------------------------------------------
+
+    @staticmethod
+    def node_should_run(ds: ext.DaemonSet, node: api.Node) -> bool:
+        """nodeShouldRunDaemonPod: readiness + nodeName/nodeSelector/affinity
+        + taint toleration (resource fit is delegated to kubelet admission)."""
+        for c in ((node.status.conditions or []) if node.status else []):
+            if c.type == api.NODE_READY and c.status != api.CONDITION_TRUE:
+                return False
+        if node.spec and node.spec.unschedulable:
+            return False
+        tpl = ds.spec.template if ds.spec else None
+        spec = tpl.spec if tpl else None
+        probe = api.Pod(metadata=api.ObjectMeta(), spec=spec or api.PodSpec())
+        if spec and spec.node_name and spec.node_name != node.metadata.name:
+            return False
+        info = NodeInfo(node)
+        try:
+            pod_matches_node_selector(probe, info)
+            pod_tolerates_node_taints(probe, info)
+        except PredicateFailure:
+            return False
+        return True
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        ns, _ = key.split("/", 1)
+        ds = self.ds_informer.store.get(key)
+        if ds is None:
+            return
+        sel = _selector(ds)
+        nodes = self.node_informer.store.list()
+        # daemon pods by node
+        by_node: Dict[str, List[api.Pod]] = {}
+        for p in self.pod_informer.store.list():
+            if (p.metadata.namespace == ns and is_pod_active(p)
+                    and sel.matches(p.metadata.labels or {})):
+                nn = p.spec.node_name if p.spec else ""
+                by_node.setdefault(nn, []).append(p)
+
+        should_run = {n.metadata.name: self.node_should_run(ds, n)
+                      for n in nodes}
+        to_create, to_delete = [], []
+        for node in nodes:
+            name = node.metadata.name
+            have = by_node.get(name, [])
+            if should_run[name] and not have:
+                to_create.append(name)
+            elif not should_run[name] and have:
+                to_delete.extend(have)
+            elif should_run[name] and len(have) > 1:
+                # more than one daemon pod on a node: keep the oldest
+                extras = sorted(have,
+                                key=lambda p: p.metadata.creation_timestamp or "")
+                to_delete.extend(extras[1:])
+
+        if self.expectations.satisfied_expectations(key):
+            self._apply(key, ds, to_create, to_delete)
+        self._update_status(ds, should_run, by_node)
+
+    def _apply(self, key, ds, to_create: List[str], to_delete: List[api.Pod]):
+        if to_create:
+            self.expectations.expect_creations(key, len(to_create))
+            done = 0
+            try:
+                for node_name in to_create:
+                    pod = pod_from_template(
+                        "DaemonSet", ds,
+                        (ds.spec.template if ds.spec else None)
+                        or api.PodTemplateSpec(),
+                        node_name=node_name)
+                    self.client.create("pods", pod, ds.metadata.namespace)
+                    done += 1
+            except ApiError:
+                for _ in range(len(to_create) - done):
+                    self.expectations.creation_observed(key)
+                raise
+        if to_delete:
+            self.expectations.expect_deletions(key, len(to_delete))
+            for i, p in enumerate(to_delete):
+                try:
+                    self.client.delete("pods", p.metadata.name,
+                                       ds.metadata.namespace)
+                except ApiError as e:
+                    if e.is_not_found:
+                        self.expectations.deletion_observed(key)
+                        continue
+                    for _ in range(len(to_delete) - i):
+                        self.expectations.deletion_observed(key)
+                    raise
+
+    def _update_status(self, ds, should_run, by_node):
+        desired = sum(1 for v in should_run.values() if v)
+        current = 0
+        mis = 0
+        for name, should in should_run.items():
+            have = bool(by_node.get(name))
+            if should:
+                current += 1 if have else 0
+            elif have:
+                mis += 1
+        st = ds.status
+        if (st and st.desired_number_scheduled == desired
+                and st.current_number_scheduled == current
+                and st.number_misscheduled == mis):
+            return
+        fresh = deep_copy(ds)
+        fresh.status = ext.DaemonSetStatus(
+            current_number_scheduled=current,
+            number_misscheduled=mis,
+            desired_number_scheduled=desired)
+        try:
+            self.client.update_status("daemonsets", fresh)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        for inf in (self.ds_informer, self.pod_informer, self.node_informer):
+            inf.run()
+        for inf in (self.ds_informer, self.pod_informer, self.node_informer):
+            inf.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        for inf in (self.ds_informer, self.pod_informer, self.node_informer):
+            inf.stop()
+
+
+def _selector(ds: ext.DaemonSet) -> labelsel.Selector:
+    return selector_for(ds)
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"

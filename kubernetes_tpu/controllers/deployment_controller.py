@@ -1,0 +1,387 @@
+"""Deployment controller: declarative rollouts over replica sets.
+
+Parity target: reference pkg/controller/deployment/deployment_controller.go
+(1,288 ln) + pkg/util/deployment/deployment.go. Reconcile shape:
+
+  - the deployment's pod template is hashed; the replica set named
+    {deployment}-{hash} (labeled pod-template-hash={hash}) is "new", every
+    other matching RS is "old" (GetNewReplicaSet / GetOldReplicaSets)
+  - Recreate: scale all old RSes to 0, wait for their pods to exit, then
+    scale the new RS up to spec.replicas
+  - RollingUpdate: scale the new RS up bounded by maxSurge, scale old RSes
+    down bounded by maxUnavailable against the count of available pods
+    (reconcileNewReplicaSet / reconcileOldReplicaSets)
+  - each new template revision bumps deployment.kubernetes.io/revision on
+    the new RS; rollback (spec.rollbackTo) copies an old RS's template back
+    into the deployment spec and clears rollbackTo (rollback in
+    deployment_controller.go:480-530)
+  - old RSes at 0 replicas beyond revisionHistoryLimit are deleted
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy, to_dict
+from kubernetes_tpu.apis import extensions as ext
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.pod_control import (
+    is_pod_active, is_pod_available, pod_template_hash, selector_for,
+)
+
+log = logging.getLogger("deployment-controller")
+
+HASH_LABEL = "pod-template-hash"
+
+
+def resolve_fenceposts(strategy: Optional[ext.DeploymentStrategy],
+                       replicas: int) -> Tuple[int, int]:
+    """(maxSurge, maxUnavailable) as absolute counts; percents round
+    surge up, unavailable down; both zero resolves to unavailable=1
+    (reference deployment.ResolveFenceposts)."""
+    ru = strategy.rolling_update if strategy and strategy.rolling_update else None
+    surge = _int_or_percent(ru.max_surge if ru else None, replicas, round_up=True,
+                            default=1)
+    unavail = _int_or_percent(ru.max_unavailable if ru else None, replicas,
+                              round_up=False, default=1)
+    if surge == 0 and unavail == 0:
+        unavail = 1
+    return surge, unavail
+
+
+def _int_or_percent(v, total: int, round_up: bool, default: int) -> int:
+    if v is None:
+        return default
+    if isinstance(v, str) and v.endswith("%"):
+        pct = int(v[:-1])
+        exact = total * pct / 100.0
+        return int(-(-exact // 1)) if round_up else int(exact)
+    return int(v)
+
+
+def _template_equal(a: Optional[api.PodTemplateSpec],
+                    b: Optional[api.PodTemplateSpec]) -> bool:
+    """Compare templates ignoring the pod-template-hash label the controller
+    itself injects (reference equalIgnoreHash)."""
+    def strip(t):
+        if t is None:
+            return {}
+        d = to_dict(deep_copy(t))
+        meta = d.get("metadata") or {}
+        (meta.get("labels") or {}).pop(HASH_LABEL, None)
+        return d
+    return strip(a) == strip(b)
+
+
+class DeploymentController(Controller):
+    name = "deployment"
+
+    def __init__(self, client: RESTClient, workers: int = 2):
+        super().__init__(workers)
+        self.client = client
+        self.d_informer = Informer(ListWatch(client, "deployments"))
+        self.rs_informer = Informer(ListWatch(client, "replicasets"))
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.d_informer.add_event_handler(
+            on_add=lambda d: self.enqueue(_key(d)),
+            on_update=lambda old, new: self.enqueue(_key(new)),
+            on_delete=lambda d: self.enqueue(_key(d)))
+        self.rs_informer.add_event_handler(
+            on_add=self._rs_changed,
+            on_update=lambda old, new: self._rs_changed(new),
+            on_delete=self._rs_changed)
+        self.pod_informer.add_event_handler(
+            on_update=lambda old, new: self._pod_changed(new),
+            on_delete=self._pod_changed)
+
+    def _rs_changed(self, rs):
+        for d in self.d_informer.store.list():
+            if d.metadata.namespace != rs.metadata.namespace:
+                continue
+            if self._selector(d).matches(rs.metadata.labels
+                                         or _tpl_labels(rs)):
+                self.enqueue(_key(d))
+
+    def _pod_changed(self, pod):
+        for d in self.d_informer.store.list():
+            if (d.metadata.namespace == pod.metadata.namespace
+                    and self._selector(d).matches(pod.metadata.labels or {})):
+                self.enqueue(_key(d))
+
+    @staticmethod
+    def _selector(d: ext.Deployment) -> labelsel.Selector:
+        return selector_for(d)
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        d = self.d_informer.store.get(key)
+        if d is None or d.spec is None:
+            return
+        if d.spec.rollback_to is not None:
+            self._rollback(d)
+            return
+        if d.spec.paused:
+            self._sync_status(d)
+            return
+        new_rs, old_rses = self._get_or_create_new_rs(d)
+        if (d.spec.strategy and d.spec.strategy.type == ext.RECREATE):
+            self._recreate(d, new_rs, old_rses)
+        else:
+            self._rolling(d, new_rs, old_rses)
+        self._cleanup_history(d, new_rs, old_rses)
+        self._sync_status(d)
+
+    # replica sets ------------------------------------------------------------
+
+    def _matching_rses(self, d) -> List[api.ReplicaSet]:
+        sel = self._selector(d)
+        return [rs for rs in self.rs_informer.store.list()
+                if rs.metadata.namespace == d.metadata.namespace
+                and sel.matches(rs.metadata.labels or _tpl_labels(rs))]
+
+    def _get_or_create_new_rs(self, d):
+        tpl_hash = pod_template_hash(d.spec.template or api.PodTemplateSpec())
+        rses = self._matching_rses(d)
+        new_rs = None
+        old_rses = []
+        for rs in rses:
+            if _template_equal(rs.spec.template if rs.spec else None,
+                               d.spec.template):
+                new_rs = rs
+            else:
+                old_rses.append(rs)
+        if new_rs is not None:
+            return new_rs, old_rses
+
+        # next revision = max(old revisions) + 1
+        max_rev = 0
+        for rs in old_rses:
+            try:
+                max_rev = max(max_rev, int(
+                    (rs.metadata.annotations or {}).get(ext.ANN_REVISION, "0")))
+            except ValueError:
+                pass
+        tpl = deep_copy(d.spec.template) if d.spec.template else \
+            api.PodTemplateSpec()
+        if tpl.metadata is None:
+            tpl.metadata = api.ObjectMeta()
+        tpl.metadata.labels = dict(tpl.metadata.labels or {})
+        tpl.metadata.labels[HASH_LABEL] = tpl_hash
+        sel = deep_copy(d.spec.selector) if d.spec.selector else \
+            api.LabelSelector(match_labels=dict(tpl.metadata.labels))
+        if sel.match_labels is None:
+            sel.match_labels = {}
+        sel.match_labels[HASH_LABEL] = tpl_hash
+        rs = api.ReplicaSet(
+            metadata=api.ObjectMeta(
+                name=f"{d.metadata.name}-{tpl_hash}",
+                namespace=d.metadata.namespace,
+                labels=dict(tpl.metadata.labels),
+                annotations={ext.ANN_REVISION: str(max_rev + 1)}),
+            spec=api.ReplicaSetSpec(replicas=0, selector=sel, template=tpl))
+        try:
+            created = self.client.create("replicasets", rs,
+                                         d.metadata.namespace)
+        except ApiError as e:
+            if not e.is_conflict:
+                raise
+            created = self.client.get("replicasets", rs.metadata.name,
+                                      d.metadata.namespace)
+        return created, old_rses
+
+    def _scale_rs(self, rs, replicas: int):
+        if (rs.spec.replicas or 0) == replicas:
+            return rs
+        fresh = deep_copy(rs)
+        fresh.spec.replicas = replicas
+        # conflicts propagate: the rate-limited requeue retries on fresh state
+        return self.client.update("replicasets", fresh, rs.metadata.namespace)
+
+    # strategies --------------------------------------------------------------
+
+    def _pods_of(self, d, sel=None) -> List[api.Pod]:
+        sel = sel or self._selector(d)
+        return [p for p in self.pod_informer.store.list()
+                if p.metadata.namespace == d.metadata.namespace
+                and sel.matches(p.metadata.labels or {})]
+
+    def _recreate(self, d, new_rs, old_rses):
+        scaled_down = False
+        for rs in old_rses:
+            if (rs.spec.replicas or 0) != 0:
+                self._scale_rs(rs, 0)
+                scaled_down = True
+        if scaled_down:
+            raise RuntimeError("recreate: waiting for old replica sets to scale down")
+        # any old pod still active -> wait (watch events requeue us)
+        old_hashes = {(_tpl_labels(rs) or {}).get(HASH_LABEL) for rs in old_rses}
+        for p in self._pods_of(d):
+            if (is_pod_active(p)
+                    and (p.metadata.labels or {}).get(HASH_LABEL) in old_hashes):
+                raise RuntimeError("recreate: old pods still terminating")
+        self._scale_rs(new_rs, d.spec.replicas or 0)
+
+    def _rolling(self, d, new_rs, old_rses):
+        replicas = d.spec.replicas or 0
+        surge, max_unavail = resolve_fenceposts(d.spec.strategy, replicas)
+        old_total = sum((rs.spec.replicas or 0) for rs in old_rses)
+        new_count = new_rs.spec.replicas or 0
+
+        # deployment scaled down below what the new RS already runs
+        # (reconcileNewReplicaSet's rsSize > deployment size branch)
+        if new_count > replicas:
+            self._scale_rs(new_rs, replicas)
+            return
+
+        # scale up new RS bounded by maxSurge (reconcileNewReplicaSet)
+        if new_count < replicas:
+            allowed = replicas + surge - old_total
+            target = max(new_count, min(replicas, allowed))
+            if target != new_count:
+                new_rs = self._scale_rs(new_rs, target)
+                return  # wait for pods; watch requeues
+
+        if old_total == 0:
+            return
+        sel = self._selector(d)
+        pods = self._pods_of(d, sel)
+        available_by_hash = {}
+        for p in pods:
+            if is_pod_available(p):
+                h = (p.metadata.labels or {}).get(HASH_LABEL, "")
+                available_by_hash[h] = available_by_hash.get(h, 0) + 1
+
+        # first scale down UNHEALTHY old replicas — killing a not-available
+        # pod can't violate maxUnavailable (cleanupUnhealthyReplicas); without
+        # this, crash-looping old pods + maxSurge=0 deadlocks the rollout
+        progressed = False
+        for rs in sorted(old_rses, key=_revision):
+            cur = rs.spec.replicas or 0
+            if cur == 0:
+                continue
+            rs_hash = (_tpl_labels(rs) or {}).get(HASH_LABEL, "")
+            healthy = available_by_hash.get(rs_hash, 0)
+            if cur > healthy:
+                self._scale_rs(rs, healthy)
+                progressed = True
+        if progressed:
+            return  # recompute totals on the requeue the scale-down triggers
+
+        # then scale down healthy old RSes bounded by maxUnavailable against
+        # AVAILABLE pods (reconcileOldReplicaSets: never dip below
+        # replicas - maxUnavailable available pods)
+        available = sum(available_by_hash.values())
+        min_available = replicas - max_unavail
+        cleanup_budget = available - min_available
+        if cleanup_budget <= 0:
+            return  # not enough ready pods to make progress yet
+        for rs in sorted(old_rses, key=_revision, reverse=True):
+            if cleanup_budget <= 0:
+                break
+            cur = rs.spec.replicas or 0
+            if cur == 0:
+                continue
+            down = min(cur, cleanup_budget)
+            self._scale_rs(rs, cur - down)
+            cleanup_budget -= down
+
+    def _cleanup_history(self, d, new_rs, old_rses):
+        limit = d.spec.revision_history_limit
+        if limit is None:
+            return
+        dead = sorted([rs for rs in old_rses if (rs.spec.replicas or 0) == 0
+                       and (rs.status is None or rs.status.replicas == 0)],
+                      key=_revision)
+        for rs in dead[: max(0, len(dead) - limit)]:
+            try:
+                self.client.delete("replicasets", rs.metadata.name,
+                                   rs.metadata.namespace)
+            except ApiError as e:
+                if not e.is_not_found:
+                    raise
+
+    # rollback ----------------------------------------------------------------
+
+    def _rollback(self, d):
+        target_rev = d.spec.rollback_to.revision
+        rses = self._matching_rses(d)
+        if target_rev == 0:  # revision 0 = previous revision
+            revs = sorted((_revision(rs) for rs in rses), reverse=True)
+            target_rev = revs[1] if len(revs) > 1 else 0
+        target = next((rs for rs in rses if _revision(rs) == target_rev), None)
+        fresh = deep_copy(self.client.get("deployments", d.metadata.name,
+                                          d.metadata.namespace))
+        if target is not None and target.spec and target.spec.template:
+            tpl = deep_copy(target.spec.template)
+            if tpl.metadata and tpl.metadata.labels:
+                tpl.metadata.labels.pop(HASH_LABEL, None)
+            fresh.spec.template = tpl
+        # clear rollbackTo whether or not the revision was found (reference
+        # emits RollbackRevisionNotFound and clears)
+        fresh.spec.rollback_to = None
+        try:
+            self.client.update("deployments", fresh, d.metadata.namespace)
+        except ApiError as e:
+            if not e.is_conflict:
+                raise
+
+    # status ------------------------------------------------------------------
+
+    def _sync_status(self, d):
+        sel = self._selector(d)
+        pods = [p for p in self._pods_of(d, sel) if is_pod_active(p)]
+        tpl_hash = pod_template_hash(d.spec.template or api.PodTemplateSpec())
+        total = len(pods)
+        updated = sum(1 for p in pods
+                      if (p.metadata.labels or {}).get(HASH_LABEL) == tpl_hash)
+        available = sum(1 for p in pods if is_pod_available(p))
+        st = d.status
+        if (st and st.replicas == total and st.updated_replicas == updated
+                and st.available_replicas == available):
+            return
+        fresh = deep_copy(d)
+        fresh.status = ext.DeploymentStatus(
+            replicas=total, updated_replicas=updated,
+            available_replicas=available,
+            unavailable_replicas=max(0, (d.spec.replicas or 0) - available))
+        try:
+            self.client.update_status("deployments", fresh)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+
+    # lifecycle ---------------------------------------------------------------
+
+    def start(self):
+        for inf in (self.d_informer, self.rs_informer, self.pod_informer):
+            inf.run()
+        for inf in (self.d_informer, self.rs_informer, self.pod_informer):
+            inf.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        for inf in (self.d_informer, self.rs_informer, self.pod_informer):
+            inf.stop()
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def _revision(rs) -> int:
+    try:
+        return int((rs.metadata.annotations or {}).get(ext.ANN_REVISION, "0"))
+    except ValueError:
+        return 0
+
+
+def _tpl_labels(rs) -> dict:
+    tpl = rs.spec.template if rs.spec else None
+    return (tpl.metadata.labels if tpl and tpl.metadata else None) or {}

@@ -1,0 +1,237 @@
+"""Job controller: run pods to completion.
+
+Parity target: reference pkg/controller/job/jobcontroller.go — count
+active/succeeded/failed pods per job; create up to parallelism (capped by
+remaining completions), delete surplus actives on scale-down; completions
+reached (or nil completions + any success) sets the Complete condition and
+stamps completionTime; activeDeadlineSeconds exceeded kills actives and sets
+Failed (syncJob / manageJob)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.apis import batch
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.expectations import ControllerExpectations
+from kubernetes_tpu.controllers.pod_control import (
+    deletion_rank, pod_from_template, selector_for,
+)
+from kubernetes_tpu.utils.timeutil import now_iso, parse_iso
+
+log = logging.getLogger("job-controller")
+
+
+class JobController(Controller):
+    name = "job"
+
+    def __init__(self, client: RESTClient, workers: int = 2):
+        super().__init__(workers)
+        self.client = client
+        self.job_informer = Informer(ListWatch(client, "jobs"))
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.expectations = ControllerExpectations()
+        self.job_informer.add_event_handler(
+            on_add=lambda j: self.enqueue(_key(j)),
+            on_update=lambda old, new: self.enqueue(_key(new)),
+            on_delete=self._job_deleted)
+        self.pod_informer.add_event_handler(
+            on_add=self._pod_added,
+            on_update=lambda old, new: self._pod_changed(new),
+            on_delete=self._pod_deleted)
+
+    def _job_deleted(self, j):
+        self.expectations.delete_expectations(_key(j))
+        self.enqueue(_key(j))
+
+    def _pod_added(self, pod):
+        for j in self._owners_of(pod):
+            self.expectations.creation_observed(_key(j))
+            self.enqueue(_key(j))
+
+    def _pod_deleted(self, pod):
+        for j in self._owners_of(pod):
+            self.expectations.deletion_observed(_key(j))
+            self.enqueue(_key(j))
+
+    def _pod_changed(self, pod):
+        for j in self._owners_of(pod):
+            self.enqueue(_key(j))
+
+    def _owners_of(self, pod) -> List[batch.Job]:
+        lbls = pod.metadata.labels or {}
+        return [j for j in self.job_informer.store.list()
+                if j.metadata.namespace == pod.metadata.namespace
+                and _selector(j).matches(lbls)]
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        ns, _ = key.split("/", 1)
+        job = self.job_informer.store.get(key)
+        if job is None:
+            return
+        if _finished(job):
+            return
+        sel = _selector(job)
+        pods = [p for p in self.pod_informer.store.list()
+                if p.metadata.namespace == ns
+                and p.metadata.deletion_timestamp is None
+                and sel.matches(p.metadata.labels or {})]
+        active = [p for p in pods if _phase(p) not in
+                  (api.POD_SUCCEEDED, api.POD_FAILED)]
+        succeeded = sum(1 for p in pods if _phase(p) == api.POD_SUCCEEDED)
+        failed = sum(1 for p in pods if _phase(p) == api.POD_FAILED)
+
+        start_time = (job.status.start_time if job.status else None) or now_iso()
+        deadline_exceeded = self._past_deadline(job, start_time)
+        if not deadline_exceeded:
+            # nothing else requeues us at the deadline — schedule the wake-up
+            # ourselves (the reference relies on its 30s resync period)
+            limit = job.spec.active_deadline_seconds if job.spec else None
+            started = parse_iso(start_time)
+            if limit is not None and started is not None:
+                self.enqueue_after(key, max(0.0, started + limit - time.time()))
+
+        condition = None
+        if deadline_exceeded:
+            # kill remaining actives, mark Failed
+            for p in active:
+                try:
+                    self.client.delete("pods", p.metadata.name, ns)
+                except ApiError as e:
+                    if not e.is_not_found:
+                        raise
+            active = []
+            condition = batch.JobCondition(
+                type=batch.JOB_FAILED, status=api.CONDITION_TRUE,
+                reason="DeadlineExceeded",
+                message="Job was active longer than specified deadline",
+                last_transition_time=now_iso())
+        else:
+            completions = job.spec.completions if job.spec else None
+            complete = (succeeded >= completions if completions is not None
+                        else succeeded > 0 and not active)
+            if complete:
+                condition = batch.JobCondition(
+                    type=batch.JOB_COMPLETE, status=api.CONDITION_TRUE,
+                    last_transition_time=now_iso())
+            elif self.expectations.satisfied_expectations(key):
+                active = self._manage(key, job, active, succeeded)
+
+        self._update_status(job, len(active), succeeded, failed, start_time,
+                            condition)
+
+    def _past_deadline(self, job, start_time: str) -> bool:
+        limit = job.spec.active_deadline_seconds if job.spec else None
+        if limit is None:
+            return False
+        started = parse_iso(start_time)
+        return started is not None and (time.time() - started) >= limit
+
+    def _manage(self, key, job, active: list, succeeded: int) -> list:
+        parallelism = job.spec.parallelism if job.spec and \
+            job.spec.parallelism is not None else 1
+        completions = job.spec.completions if job.spec else None
+        if completions is not None:
+            want_active = min(parallelism, max(0, completions - succeeded))
+        else:
+            want_active = parallelism
+        diff = want_active - len(active)
+        if diff > 0:
+            self.expectations.expect_creations(key, diff)
+            done = 0
+            try:
+                for _ in range(diff):
+                    pod = pod_from_template(
+                        "Job", job,
+                        (job.spec.template if job.spec else None)
+                        or api.PodTemplateSpec())
+                    self.client.create("pods", pod, job.metadata.namespace)
+                    done += 1
+            except ApiError:
+                for _ in range(diff - done):
+                    self.expectations.creation_observed(key)
+                raise
+        elif diff < 0:
+            victims = sorted(active, key=deletion_rank)[: -diff]
+            self.expectations.expect_deletions(key, len(victims))
+            remaining = [p for p in active if p not in victims]
+            for i, p in enumerate(victims):
+                try:
+                    self.client.delete("pods", p.metadata.name,
+                                       job.metadata.namespace)
+                except ApiError as e:
+                    if e.is_not_found:
+                        self.expectations.deletion_observed(key)
+                        continue
+                    for _ in range(len(victims) - i):
+                        self.expectations.deletion_observed(key)
+                    raise
+            return remaining
+        return active
+
+    def _update_status(self, job, active: int, succeeded: int, failed: int,
+                       start_time: str, condition) -> None:
+        st = job.status or batch.JobStatus()
+        changed = (st.active != active or st.succeeded != succeeded
+                   or st.failed != failed or st.start_time != start_time
+                   or condition is not None)
+        if not changed:
+            return
+        fresh = deep_copy(job)
+        conditions = list((st.conditions or []))
+        if condition is not None:
+            conditions.append(condition)
+        fresh.status = batch.JobStatus(
+            conditions=conditions or None, start_time=start_time,
+            completion_time=(now_iso() if condition is not None
+                             and condition.type == batch.JOB_COMPLETE
+                             else st.completion_time),
+            active=active, succeeded=succeeded, failed=failed)
+        try:
+            self.client.update_status("jobs", fresh)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.job_informer.run()
+        self.pod_informer.run()
+        self.job_informer.wait_for_sync()
+        self.pod_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.job_informer.stop()
+        self.pod_informer.stop()
+
+
+def _selector(job: batch.Job) -> labelsel.Selector:
+    return selector_for(job)
+
+
+def _finished(job: batch.Job) -> bool:
+    for c in ((job.status.conditions or []) if job.status else []):
+        if c.type in (batch.JOB_COMPLETE, batch.JOB_FAILED) and \
+                c.status == api.CONDITION_TRUE:
+            return True
+    return False
+
+
+def _phase(pod: api.Pod) -> str:
+    return pod.status.phase if pod.status else ""
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"

@@ -11,9 +11,13 @@ from typing import List, Optional
 
 from kubernetes_tpu.client import RESTClient
 from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
+from kubernetes_tpu.controllers.daemonset_controller import DaemonSetController
+from kubernetes_tpu.controllers.deployment_controller import DeploymentController
 from kubernetes_tpu.controllers.endpoints_controller import EndpointsController
+from kubernetes_tpu.controllers.job_controller import JobController
 from kubernetes_tpu.controllers.namespace_controller import NamespaceController
 from kubernetes_tpu.controllers.node_controller import NodeController
+from kubernetes_tpu.controllers.replicaset_controller import ReplicaSetController
 from kubernetes_tpu.controllers.replication_controller import ReplicationManager
 
 log = logging.getLogger("controller-manager")
@@ -35,6 +39,10 @@ class ControllerManager:
         self._started = True
         self.controllers = [
             ReplicationManager(self.client),
+            ReplicaSetController(self.client),
+            DeploymentController(self.client),
+            DaemonSetController(self.client),
+            JobController(self.client),
             EndpointsController(self.client),
             NodeController(self.client),
             NamespaceController(self.client),

@@ -1,0 +1,96 @@
+"""Shared pod create/delete helpers for pod-managing controllers.
+
+Parity target: reference pkg/controller/controller_utils.go PodControlInterface
+(RealPodControl.CreatePods / CreatePodsOnNode / DeletePod) and the activePods
+deletion ranking used by replicaset/replication controllers."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy, to_dict
+
+
+def created_by_annotation(kind: str, owner) -> str:
+    return json.dumps({"kind": kind,
+                       "namespace": owner.metadata.namespace,
+                       "name": owner.metadata.name,
+                       "uid": owner.metadata.uid})
+
+
+def pod_from_template(kind: str, owner, template: api.PodTemplateSpec,
+                      extra_labels: Optional[dict] = None,
+                      node_name: str = "") -> api.Pod:
+    """Build (not create) a pod from a controller's template, stamped with the
+    created-by annotation (reference controller_utils.go GetPodFromTemplate)."""
+    labels = dict((template.metadata.labels if template.metadata else None) or {})
+    if extra_labels:
+        labels.update(extra_labels)
+    spec = deep_copy(template.spec) if template.spec else api.PodSpec(
+        containers=[api.Container(name="c", image="pause")])
+    if node_name:
+        spec.node_name = node_name
+    return api.Pod(
+        metadata=api.ObjectMeta(
+            generate_name=f"{owner.metadata.name}-",
+            namespace=owner.metadata.namespace,
+            labels=labels,
+            annotations={api.ANN_CREATED_BY: created_by_annotation(kind, owner)}),
+        spec=spec)
+
+
+def pod_template_hash(template: api.PodTemplateSpec) -> str:
+    """Deterministic hash of a pod template, used to name/label the replica
+    set a deployment owns (reference pkg/util/deployment GetPodTemplateSpecHash
+    via fnv; we hash the canonical JSON encoding instead)."""
+    canon = json.dumps(to_dict(template), sort_keys=True)
+    return hashlib.sha1(canon.encode()).hexdigest()[:10]
+
+
+def is_pod_active(pod: api.Pod) -> bool:
+    phase = pod.status.phase if pod.status else ""
+    return (pod.metadata.deletion_timestamp is None
+            and phase not in (api.POD_SUCCEEDED, api.POD_FAILED))
+
+
+def is_pod_ready(pod: api.Pod) -> bool:
+    for c in ((pod.status.conditions or []) if pod.status else []):
+        if c.type == api.POD_READY:
+            return c.status == api.CONDITION_TRUE
+    return False
+
+
+def is_pod_available(pod: api.Pod) -> bool:
+    """Running + Ready (minReadySeconds elided; reference
+    pkg/util/deployment.IsPodAvailable)."""
+    return (is_pod_active(pod)
+            and (pod.status.phase if pod.status else "") == api.POD_RUNNING
+            and is_pod_ready(pod))
+
+
+def selector_for(obj) -> labelsel.Selector:
+    """Structured spec.selector, defaulting to the pod template's labels when
+    absent (the server-side selector defaulting every workload strategy in the
+    reference applies; shared by RC/RS/Deployment/DaemonSet/Job controllers)."""
+    sel = obj.spec.selector if obj.spec else None
+    if sel is None:
+        tpl = getattr(obj.spec, "template", None) if obj.spec else None
+        return labelsel.selector_from_map(
+            (tpl.metadata.labels if tpl and tpl.metadata else None) or {})
+    if isinstance(sel, dict):  # RC's map-form selector
+        return labelsel.selector_from_map(sel)
+    if isinstance(sel, api.LabelSelector):
+        return labelsel.selector_from_label_selector(sel)
+    return labelsel.selector_from_map(sel or {})
+
+
+def deletion_rank(pod: api.Pod):
+    """Sort key: unassigned first, then not-running, then unready — the pods
+    cheapest to kill go first (reference controller_utils.go ActivePods.Less)."""
+    assigned = bool(pod.spec and pod.spec.node_name)
+    phase = pod.status.phase if pod.status else ""
+    return (assigned, phase == api.POD_RUNNING, is_pod_ready(pod))

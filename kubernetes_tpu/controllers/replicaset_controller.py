@@ -1,0 +1,151 @@
+"""ReplicaSet controller: next-gen replication with set-based selectors.
+
+Parity target: reference pkg/controller/replicaset/replica_set.go — identical
+reconcile shape to the replication controller but selecting pods with the
+structured LabelSelector {matchLabels, matchExpressions}. Deployments manage
+replicas through these (see deployment_controller.py)."""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.apis import extensions as ext  # noqa: F401  (group home of ReplicaSet routes)
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.expectations import ControllerExpectations
+from kubernetes_tpu.controllers.pod_control import (
+    deletion_rank, is_pod_active, is_pod_ready, pod_from_template, selector_for,
+)
+
+log = logging.getLogger("replicaset-controller")
+
+
+def _selector(rs: api.ReplicaSet) -> labelsel.Selector:
+    return selector_for(rs)
+
+
+class ReplicaSetController(Controller):
+    name = "replicaset"
+
+    def __init__(self, client: RESTClient, workers: int = 2,
+                 burst_replicas: int = 500):
+        super().__init__(workers)
+        self.client = client
+        self.burst = burst_replicas
+        self.rs_informer = Informer(ListWatch(client, "replicasets"))
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.expectations = ControllerExpectations()
+        self.rs_informer.add_event_handler(
+            on_add=lambda rs: self.enqueue(_key(rs)),
+            on_update=lambda old, new: self.enqueue(_key(new)),
+            on_delete=self._rs_deleted)
+        self.pod_informer.add_event_handler(
+            on_add=self._pod_added,
+            on_update=lambda old, new: self._pod_changed(new),
+            on_delete=self._pod_deleted)
+
+    def _rs_deleted(self, rs):
+        self.expectations.delete_expectations(_key(rs))
+        self.enqueue(_key(rs))
+
+    def _pod_added(self, pod):
+        for rs in self._owners_of(pod):
+            self.expectations.creation_observed(_key(rs))
+            self.enqueue(_key(rs))
+
+    def _pod_deleted(self, pod):
+        for rs in self._owners_of(pod):
+            self.expectations.deletion_observed(_key(rs))
+            self.enqueue(_key(rs))
+
+    def _pod_changed(self, pod):
+        for rs in self._owners_of(pod):
+            self.enqueue(_key(rs))
+
+    def _owners_of(self, pod: api.Pod) -> List[api.ReplicaSet]:
+        lbls = pod.metadata.labels or {}
+        return [rs for rs in self.rs_informer.store.list()
+                if rs.metadata.namespace == pod.metadata.namespace
+                and _selector(rs).matches(lbls)]
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        ns, _ = key.split("/", 1)
+        rs = self.rs_informer.store.get(key)
+        if rs is None:
+            return
+        sel = _selector(rs)
+        pods = [p for p in self.pod_informer.store.list()
+                if p.metadata.namespace == ns and is_pod_active(p)
+                and sel.matches(p.metadata.labels or {})]
+        if self.expectations.satisfied_expectations(key):
+            self._manage_replicas(key, rs, pods)
+        self._update_status(rs, pods)
+
+    def _manage_replicas(self, key: str, rs, pods: list) -> None:
+        diff = (rs.spec.replicas or 0) - len(pods)
+        if diff > 0:
+            n = min(diff, self.burst)
+            self.expectations.expect_creations(key, n)
+            created = 0
+            try:
+                for _ in range(n):
+                    pod = pod_from_template("ReplicaSet", rs, rs.spec.template
+                                            or api.PodTemplateSpec())
+                    self.client.create("pods", pod, rs.metadata.namespace)
+                    created += 1
+            except ApiError:
+                for _ in range(n - created):
+                    self.expectations.creation_observed(key)
+                raise
+        elif diff < 0:
+            victims = sorted(pods, key=deletion_rank)[: min(-diff, self.burst)]
+            self.expectations.expect_deletions(key, len(victims))
+            for i, p in enumerate(victims):
+                try:
+                    self.client.delete("pods", p.metadata.name,
+                                       rs.metadata.namespace)
+                except ApiError as e:
+                    if e.is_not_found:
+                        self.expectations.deletion_observed(key)
+                        continue
+                    for _ in range(len(victims) - i):
+                        self.expectations.deletion_observed(key)
+                    raise
+
+    def _update_status(self, rs, pods: list):
+        n, ready = len(pods), sum(1 for p in pods if is_pod_ready(p))
+        st = rs.status
+        if st and st.replicas == n and getattr(st, "ready_replicas", 0) == ready:
+            return
+        fresh = deep_copy(rs)
+        fresh.status = api.ReplicaSetStatus(replicas=n, ready_replicas=ready)
+        try:
+            self.client.update_status("replicasets", fresh)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.rs_informer.run()
+        self.pod_informer.run()
+        self.rs_informer.wait_for_sync()
+        self.pod_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.rs_informer.stop()
+        self.pod_informer.stop()
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"

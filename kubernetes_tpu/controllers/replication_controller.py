@@ -8,7 +8,6 @@ the owning RC. The created-by annotation records provenance
 
 from __future__ import annotations
 
-import json
 import logging
 from typing import List
 
@@ -19,6 +18,9 @@ from kubernetes_tpu.client import Informer, ListWatch, RESTClient
 from kubernetes_tpu.client.rest import ApiError
 from kubernetes_tpu.controllers.base import Controller
 from kubernetes_tpu.controllers.expectations import ControllerExpectations
+from kubernetes_tpu.controllers.pod_control import (
+    deletion_rank, is_pod_active, pod_from_template,
+)
 
 log = logging.getLogger("rc-controller")
 
@@ -82,8 +84,7 @@ class ReplicationManager(Controller):
         sel = labelsel.selector_from_map(rc.spec.selector)
         pods = [p for p in self.pod_informer.store.list()
                 if p.metadata.namespace == ns
-                and p.metadata.deletion_timestamp is None
-                and _is_active(p)
+                and is_pod_active(p)
                 and sel.matches(p.metadata.labels or {})]
         if self.expectations.satisfied_expectations(key):
             self._manage_replicas(key, rc, pods)
@@ -111,7 +112,7 @@ class ReplicationManager(Controller):
         elif diff < 0:
             # delete surplus: prefer unassigned, then unready (the reference
             # sorts by activePods ranking)
-            victims = sorted(pods, key=_deletion_rank)[: min(-diff, self.burst)]
+            victims = sorted(pods, key=deletion_rank)[: min(-diff, self.burst)]
             self.expectations.expect_deletions(key, len(victims))
             for i, p in enumerate(victims):
                 try:
@@ -127,18 +128,8 @@ class ReplicationManager(Controller):
                     raise
 
     def _create_pod(self, rc: api.ReplicationController):
-        tpl = rc.spec.template or api.PodTemplateSpec()
-        pod = api.Pod(
-            metadata=api.ObjectMeta(
-                generate_name=f"{rc.metadata.name}-",
-                namespace=rc.metadata.namespace,
-                labels=dict((tpl.metadata.labels if tpl.metadata else None) or {}),
-                annotations={api.ANN_CREATED_BY: json.dumps(
-                    {"kind": "ReplicationController",
-                     "namespace": rc.metadata.namespace,
-                     "name": rc.metadata.name, "uid": rc.metadata.uid})}),
-            spec=deep_copy(tpl.spec) if tpl.spec else api.PodSpec(
-                containers=[api.Container(name="c", image="pause")]))
+        pod = pod_from_template("ReplicationController", rc,
+                                rc.spec.template or api.PodTemplateSpec())
         self.client.create("pods", pod, rc.metadata.namespace)
 
     def _update_status(self, rc: api.ReplicationController, pods: list):
@@ -170,17 +161,3 @@ class ReplicationManager(Controller):
 
 def _key(obj) -> str:
     return f"{obj.metadata.namespace}/{obj.metadata.name}"
-
-
-def _is_active(pod: api.Pod) -> bool:
-    phase = pod.status.phase if pod.status else ""
-    return phase not in (api.POD_SUCCEEDED, api.POD_FAILED)
-
-
-def _deletion_rank(pod: api.Pod):
-    """Unassigned first, then pending, then unready (activePods order)."""
-    assigned = bool(pod.spec and pod.spec.node_name)
-    phase = pod.status.phase if pod.status else ""
-    ready = any(c.type == api.POD_READY and c.status == api.CONDITION_TRUE
-                for c in ((pod.status.conditions or []) if pod.status else []))
-    return (assigned, phase == api.POD_RUNNING, ready)

@@ -1,8 +1,21 @@
 """Shared timestamp helpers (one format for server- and client-stamped
 metadata/events)."""
 
+import calendar
 import time
+from typing import Optional
 
 
 def now_iso() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def parse_iso(ts: Optional[str]) -> Optional[float]:
+    """Inverse of now_iso: RFC3339 'Z' timestamp -> unix seconds (None on
+    missing/unparseable input)."""
+    if not ts:
+        return None
+    try:
+        return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return None

@@ -1,0 +1,379 @@
+"""ReplicaSet / Deployment / DaemonSet / Job controllers against a live
+in-process cluster (reference pkg/controller/{replicaset,deployment,daemon,job}
+unit+integration shapes)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apis import batch, extensions as ext
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.controllers.daemonset_controller import DaemonSetController
+from kubernetes_tpu.controllers.deployment_controller import (
+    DeploymentController, resolve_fenceposts,
+)
+from kubernetes_tpu.controllers.job_controller import JobController
+from kubernetes_tpu.controllers.replicaset_controller import ReplicaSetController
+
+HASH_LABEL = "pod-template-hash"
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=2000, burst=2000)
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:
+            pass
+        time.sleep(0.03)
+    raise AssertionError("condition not met")
+
+
+def _template(labels):
+    return api.PodTemplateSpec(
+        metadata=api.ObjectMeta(labels=dict(labels)),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="pause")]))
+
+
+def _pods(client, selector=None):
+    return client.list("pods", "default", label_selector=selector)[0]
+
+
+def _retry_update(client, resource, name, ns, mutate, attempts=10):
+    """Read-modify-write with conflict retry (controllers bump rv under us)."""
+    from kubernetes_tpu.client.rest import ApiError
+    for _ in range(attempts):
+        obj = client.get(resource, name, ns)
+        mutate(obj)
+        try:
+            return client.update(resource, obj, ns)
+        except ApiError as e:
+            if not e.is_conflict:
+                raise
+            time.sleep(0.02)
+    raise AssertionError("update kept conflicting")
+
+
+def _mark_running_ready(client, pod):
+    pod.status = api.PodStatus(
+        phase=api.POD_RUNNING,
+        conditions=[api.PodCondition(type=api.POD_READY,
+                                     status=api.CONDITION_TRUE)])
+    client.update_status("pods", pod)
+
+
+class TestReplicaSetController:
+    def test_scale_up_down_and_status(self, client):
+        ctrl = ReplicaSetController(client)
+        ctrl.start()
+        try:
+            rs = api.ReplicaSet(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ReplicaSetSpec(
+                    replicas=3,
+                    selector=api.LabelSelector(match_labels={"app": "web"}),
+                    template=_template({"app": "web"})))
+            client.create("replicasets", rs, "default")
+            _wait(lambda: len(_pods(client, "app=web")) == 3)
+
+            _retry_update(client, "replicasets", "web", "default",
+                          lambda rs: setattr(rs.spec, "replicas", 1))
+            _wait(lambda: len(_pods(client, "app=web")) == 1)
+            _wait(lambda: client.get("replicasets", "web", "default")
+                  .status.replicas == 1)
+        finally:
+            ctrl.stop()
+
+    def test_match_expressions_selector(self, client):
+        ctrl = ReplicaSetController(client)
+        ctrl.start()
+        try:
+            rs = api.ReplicaSet(
+                metadata=api.ObjectMeta(name="exp", namespace="default"),
+                spec=api.ReplicaSetSpec(
+                    replicas=2,
+                    selector=api.LabelSelector(match_expressions=[
+                        api.LabelSelectorRequirement(
+                            key="tier", operator="In",
+                            values=["web", "api"])]),
+                    template=_template({"tier": "web"})))
+            client.create("replicasets", rs, "default")
+            _wait(lambda: len(_pods(client, "tier in (web,api)")) == 2)
+        finally:
+            ctrl.stop()
+
+
+class TestDeploymentController:
+    def test_fenceposts(self):
+        s = ext.DeploymentStrategy(rolling_update=ext.RollingUpdateDeployment(
+            max_surge="25%", max_unavailable="25%"))
+        assert resolve_fenceposts(s, 10) == (3, 2)   # surge up, unavail down
+        assert resolve_fenceposts(None, 10) == (1, 1)
+        z = ext.DeploymentStrategy(rolling_update=ext.RollingUpdateDeployment(
+            max_surge=0, max_unavailable=0))
+        assert resolve_fenceposts(z, 10) == (0, 1)   # both-zero fencepost
+
+    def _deploy(self, client, name="dep", image="img:v1", replicas=2):
+        tpl = _template({"app": name})
+        tpl.spec.containers[0].image = image
+        d = ext.Deployment(
+            metadata=api.ObjectMeta(name=name, namespace="default"),
+            spec=ext.DeploymentSpec(
+                replicas=replicas,
+                selector=api.LabelSelector(match_labels={"app": name}),
+                template=tpl))
+        return client.create("deployments", d, "default")
+
+    def test_creates_replicaset_and_pods(self, client):
+        dc = DeploymentController(client)
+        rsc = ReplicaSetController(client)
+        dc.start()
+        rsc.start()
+        try:
+            self._deploy(client)
+            _wait(lambda: len(client.list("replicasets", "default")[0]) == 1)
+            rs = client.list("replicasets", "default")[0][0]
+            assert rs.metadata.name.startswith("dep-")
+            assert (rs.metadata.labels or {}).get(HASH_LABEL)
+            _wait(lambda: len(_pods(client, "app=dep")) == 2)
+        finally:
+            dc.stop()
+            rsc.stop()
+
+    def test_rolling_update_rolls_all_pods(self, client):
+        dc = DeploymentController(client)
+        rsc = ReplicaSetController(client)
+        dc.start()
+        rsc.start()
+        try:
+            self._deploy(client, image="img:v1", replicas=2)
+            _wait(lambda: len(_pods(client, "app=dep")) == 2)
+            # pods become available -> kubelet-in-miniature
+            for p in _pods(client, "app=dep"):
+                _mark_running_ready(client, p)
+
+            def set_v2(d):
+                d.spec.template.spec.containers[0].image = "img:v2"
+            _retry_update(client, "deployments", "dep", "default", set_v2)
+
+            # eventually: 2 RSes, old at 0, new at 2, all pods on img:v2
+            def rolled():
+                rses = client.list("replicasets", "default")[0]
+                if len(rses) != 2:
+                    return False
+                by_size = sorted(rses, key=lambda r: r.spec.replicas or 0)
+                if (by_size[0].spec.replicas or 0) != 0 or \
+                   (by_size[1].spec.replicas or 0) != 2:
+                    return False
+                pods = [p for p in _pods(client, "app=dep")
+                        if p.metadata.deletion_timestamp is None]
+                if len(pods) != 2:
+                    return False
+                for p in pods:
+                    if p.spec.containers[0].image != "img:v2":
+                        return False
+                    _mark_running_ready(client, p)  # keep rollout moving
+                return True
+
+            # keep marking new pods ready so the rollout can progress
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                for p in _pods(client, "app=dep"):
+                    st = p.status
+                    if not (st and st.phase == api.POD_RUNNING):
+                        try:
+                            _mark_running_ready(client, p)
+                        except Exception:
+                            pass
+                if rolled():
+                    break
+                time.sleep(0.05)
+            assert rolled()
+            # revision annotations moved forward
+            revs = sorted(int((r.metadata.annotations or {}).get(
+                ext.ANN_REVISION, "0"))
+                for r in client.list("replicasets", "default")[0])
+            assert revs == [1, 2]
+        finally:
+            dc.stop()
+            rsc.stop()
+
+    def test_scale_down_shrinks_new_replicaset(self, client):
+        dc = DeploymentController(client)
+        rsc = ReplicaSetController(client)
+        dc.start()
+        rsc.start()
+        try:
+            self._deploy(client, replicas=4)
+            _wait(lambda: len(_pods(client, "app=dep")) == 4)
+            _retry_update(client, "deployments", "dep", "default",
+                          lambda d: setattr(d.spec, "replicas", 2))
+            _wait(lambda: len([p for p in _pods(client, "app=dep")
+                               if p.metadata.deletion_timestamp is None]) == 2)
+            rs = client.list("replicasets", "default")[0][0]
+            assert (rs.spec.replicas or 0) == 2
+        finally:
+            dc.stop()
+            rsc.stop()
+
+    def test_rollback_restores_old_template(self, client):
+        dc = DeploymentController(client)
+        dc.start()
+        try:
+            self._deploy(client, image="img:v1", replicas=1)
+            _wait(lambda: len(client.list("replicasets", "default")[0]) == 1)
+            def set_v2(d):
+                d.spec.template.spec.containers[0].image = "img:v2"
+            _retry_update(client, "deployments", "dep", "default", set_v2)
+            _wait(lambda: len(client.list("replicasets", "default")[0]) == 2)
+
+            client.rollback_deployment(
+                "dep", "default",
+                ext.DeploymentRollback(name="dep",
+                                       rollback_to=ext.RollbackConfig(revision=0)))
+            _wait(lambda: client.get("deployments", "dep", "default")
+                  .spec.template.spec.containers[0].image == "img:v1")
+            assert client.get("deployments", "dep", "default") \
+                .spec.rollback_to is None
+        finally:
+            dc.stop()
+
+
+class TestDaemonSetController:
+    def _node(self, name, labels=None, ready=True, taints=None):
+        return api.Node(
+            metadata=api.ObjectMeta(name=name, labels=labels or {}),
+            spec=api.NodeSpec(taints=taints),
+            status=api.NodeStatus(
+                allocatable={"cpu": "4", "memory": "8Gi", "pods": "110"},
+                conditions=[api.NodeCondition(
+                    type=api.NODE_READY,
+                    status=api.CONDITION_TRUE if ready
+                    else api.CONDITION_FALSE)]))
+
+    def test_one_pod_per_eligible_node(self, client):
+        for i in range(3):
+            client.create("nodes", self._node(f"n{i}"))
+        client.create("nodes", self._node("n-notready", ready=False))
+        ctrl = DaemonSetController(client)
+        ctrl.start()
+        try:
+            ds = ext.DaemonSet(
+                metadata=api.ObjectMeta(name="agent", namespace="default"),
+                spec=ext.DaemonSetSpec(
+                    selector=api.LabelSelector(match_labels={"ds": "agent"}),
+                    template=_template({"ds": "agent"})))
+            client.create("daemonsets", ds, "default")
+            _wait(lambda: len(_pods(client, "ds=agent")) == 3)
+            nodes_assigned = {p.spec.node_name for p in _pods(client, "ds=agent")}
+            assert nodes_assigned == {"n0", "n1", "n2"}
+
+            # new node joining gets a daemon pod
+            client.create("nodes", self._node("n3"))
+            _wait(lambda: len(_pods(client, "ds=agent")) == 4)
+
+            # status reflects desired/current
+            _wait(lambda: client.get("daemonsets", "agent", "default")
+                  .status.desired_number_scheduled == 4)
+        finally:
+            ctrl.stop()
+
+    def test_node_selector_and_taints(self, client):
+        client.create("nodes", self._node("gpu1", labels={"accel": "tpu"}))
+        client.create("nodes", self._node("cpu1"))
+        client.create("nodes", self._node(
+            "tainted", labels={"accel": "tpu"},
+            taints=[api.Taint(key="dedicated", value="x",
+                              effect=api.TAINT_NO_SCHEDULE)]))
+        ctrl = DaemonSetController(client)
+        ctrl.start()
+        try:
+            tpl = _template({"ds": "tpu-agent"})
+            tpl.spec.node_selector = {"accel": "tpu"}
+            ds = ext.DaemonSet(
+                metadata=api.ObjectMeta(name="tpu-agent", namespace="default"),
+                spec=ext.DaemonSetSpec(
+                    selector=api.LabelSelector(match_labels={"ds": "tpu-agent"}),
+                    template=tpl))
+            client.create("daemonsets", ds, "default")
+            _wait(lambda: {p.spec.node_name
+                           for p in _pods(client, "ds=tpu-agent")} == {"gpu1"})
+            time.sleep(0.3)  # no pod ever lands on cpu1/tainted
+            assert {p.spec.node_name
+                    for p in _pods(client, "ds=tpu-agent")} == {"gpu1"}
+        finally:
+            ctrl.stop()
+
+
+class TestJobController:
+    def _job(self, name="sum", parallelism=2, completions=4, **kw):
+        return batch.Job(
+            metadata=api.ObjectMeta(name=name, namespace="default"),
+            spec=batch.JobSpec(
+                parallelism=parallelism, completions=completions,
+                selector=api.LabelSelector(match_labels={"job": name}),
+                template=_template({"job": name}), **kw))
+
+    def test_runs_to_completion(self, client):
+        ctrl = JobController(client)
+        ctrl.start()
+        try:
+            client.create("jobs", self._job(), "default")
+            _wait(lambda: len(_pods(client, "job=sum")) == 2)
+
+            # finish pods one by one; controller backfills until 4 completions
+            seen_done = set()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                for p in _pods(client, "job=sum"):
+                    if p.metadata.name not in seen_done and \
+                            (p.status is None or
+                             p.status.phase != api.POD_SUCCEEDED):
+                        p.status = api.PodStatus(phase=api.POD_SUCCEEDED)
+                        try:
+                            client.update_status("pods", p)
+                            seen_done.add(p.metadata.name)
+                        except Exception:
+                            pass
+                job = client.get("jobs", "sum", "default")
+                st = job.status
+                if st and st.succeeded >= 4 and any(
+                        c.type == batch.JOB_COMPLETE and
+                        c.status == api.CONDITION_TRUE
+                        for c in (st.conditions or [])):
+                    break
+                time.sleep(0.05)
+            job = client.get("jobs", "sum", "default")
+            assert job.status.succeeded >= 4
+            assert any(c.type == batch.JOB_COMPLETE for c in
+                       (job.status.conditions or []))
+            assert job.status.completion_time
+        finally:
+            ctrl.stop()
+
+    def test_parallelism_cap(self, client):
+        ctrl = JobController(client)
+        ctrl.start()
+        try:
+            client.create("jobs", self._job(name="cap", parallelism=3,
+                                            completions=10), "default")
+            _wait(lambda: len(_pods(client, "job=cap")) == 3)
+            time.sleep(0.3)
+            assert len(_pods(client, "job=cap")) == 3  # never exceeds parallelism
+        finally:
+            ctrl.stop()
