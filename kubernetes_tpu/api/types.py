@@ -65,6 +65,17 @@ LABEL_HOSTNAME = "kubernetes.io/hostname"
 
 
 @dataclass
+class OwnerReference:
+    """Identifies an owning object; same-namespace only (reference
+    pkg/api/types.go:2324-2342). Drives the garbage collector's cascade."""
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = api_field("uid", default="")
+    controller: Optional[bool] = None
+
+
+@dataclass
 class ObjectMeta:
     name: str = ""
     generate_name: str = ""
@@ -75,6 +86,7 @@ class ObjectMeta:
     deletion_timestamp: Optional[str] = None
     labels: Optional[Dict[str, str]] = None
     annotations: Optional[Dict[str, str]] = None
+    owner_references: Optional[List["OwnerReference"]] = None
 
 
 @dataclass

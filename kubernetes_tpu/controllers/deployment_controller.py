@@ -183,7 +183,10 @@ class DeploymentController(Controller):
                 name=f"{d.metadata.name}-{tpl_hash}",
                 namespace=d.metadata.namespace,
                 labels=dict(tpl.metadata.labels),
-                annotations={ext.ANN_REVISION: str(max_rev + 1)}),
+                annotations={ext.ANN_REVISION: str(max_rev + 1)},
+                owner_references=[api.OwnerReference(
+                    kind="Deployment", name=d.metadata.name,
+                    uid=d.metadata.uid, controller=True)]),
             spec=api.ReplicaSetSpec(replicas=0, selector=sel, template=tpl))
         try:
             created = self.client.create("replicasets", rs,

@@ -1,0 +1,208 @@
+"""Garbage collection: ownerReference cascade + terminated-pod GC.
+
+Parity targets:
+  - GarbageCollector (reference pkg/controller/garbagecollector/
+    garbagecollector.go): watches a set of resources, maintains a uid->object
+    ownership graph, and deletes any dependent whose owners have ALL been
+    deleted. This is what makes deleting a Deployment cascade to its
+    ReplicaSets and their pods (each stamped with ownerReferences by the
+    controllers that created them).
+  - PodGCController (reference pkg/controller/gc/gc_controller.go): when the
+    cluster's terminated (Succeeded/Failed) pod count exceeds a threshold,
+    deletes the oldest terminated pods down to the threshold.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+
+log = logging.getLogger("garbage-collector")
+
+# resources the collector watches, and the kind an ownerReference names
+DEFAULT_MONITORED = ("pods", "replicasets", "replicationcontrollers",
+                     "deployments", "jobs", "daemonsets")
+KIND_TO_RESOURCE = {
+    "Pod": "pods",
+    "ReplicaSet": "replicasets",
+    "ReplicationController": "replicationcontrollers",
+    "Deployment": "deployments",
+    "Job": "jobs",
+    "DaemonSet": "daemonsets",
+    "PetSet": "petsets",
+}
+
+
+class GarbageCollector(Controller):
+    """Deletes dependents whose owners are all gone. Keys are
+    "resource|namespace/name" so one workqueue serves every monitored type."""
+
+    name = "garbagecollector"
+
+    def __init__(self, client: RESTClient, workers: int = 2,
+                 monitored=DEFAULT_MONITORED):
+        super().__init__(workers)
+        self.client = client
+        self.monitored = tuple(monitored)
+        self.informers: Dict[str, Informer] = {}
+        # ownership graph (reference uidToNode): the live-uid set plus an
+        # owner-uid -> dependent-keys index so a delete event fans out in
+        # O(dependents), not a full store scan
+        self._live_uids: Dict[str, bool] = {}
+        self._dependents: Dict[str, set] = {}
+        self._uids_lock = threading.Lock()
+        for res in self.monitored:
+            inf = Informer(ListWatch(client, res))
+            self.informers[res] = inf
+            inf.add_event_handler(
+                on_add=lambda obj, r=res: self._observe(r, obj),
+                on_update=lambda old, new, r=res: self._observe(r, new),
+                on_delete=lambda obj, r=res: self._owner_deleted(r, obj))
+
+    # --- graph maintenance ---------------------------------------------------
+
+    def _observe(self, resource: str, obj):
+        meta = obj.metadata
+        uid = meta.uid if meta else ""
+        key = f"{resource}|{_nn(obj)}"
+        with self._uids_lock:
+            if uid:
+                self._live_uids[uid] = True
+            for ref in (meta.owner_references if meta else None) or []:
+                self._dependents.setdefault(ref.uid, set()).add(key)
+        if meta and meta.owner_references:
+            self.enqueue(key)
+
+    def _owner_deleted(self, resource: str, obj):
+        meta = obj.metadata
+        uid = meta.uid if meta else ""
+        with self._uids_lock:
+            if uid:
+                self._live_uids.pop(uid, None)
+            dependents = self._dependents.pop(uid, set()) if uid else set()
+            # drop this object from any dependent index it appears in
+            key = f"{resource}|{_nn(obj)}"
+            for ref in (meta.owner_references if meta else None) or []:
+                deps = self._dependents.get(ref.uid)
+                if deps:
+                    deps.discard(key)
+        for dep_key in dependents:
+            self.enqueue(dep_key)
+
+    def _owner_alive(self, ns: str, ref: api.OwnerReference) -> bool:
+        with self._uids_lock:
+            if ref.uid in self._live_uids:
+                return True
+        # informer may lag: confirm with the API before condemning (the
+        # reference does an apiserver GET in attemptToDeleteItem too)
+        res = KIND_TO_RESOURCE.get(ref.kind)
+        if res is None:
+            return True  # unknown owner kinds never orphan their dependents
+        try:
+            obj = self.client.get(res, ref.name,
+                                  ns if _is_namespaced(res) else "")
+        except ApiError as e:
+            if e.is_not_found:
+                return False
+            raise
+        return (obj.metadata.uid == ref.uid) if ref.uid else True
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        resource, nn = key.split("|", 1)
+        ns, name = nn.split("/", 1) if "/" in nn else ("", nn)
+        obj = self.informers[resource].store.get(nn)
+        if obj is None:
+            return
+        refs = obj.metadata.owner_references if obj.metadata else None
+        if not refs:
+            return
+        if any(self._owner_alive(ns, r) for r in refs):
+            return
+        log.info("gc: deleting orphaned %s %s", resource, nn)
+        try:
+            self.client.delete(resource, name, ns)
+        except ApiError as e:
+            if not e.is_not_found:
+                raise
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        for inf in self.informers.values():
+            inf.run()
+        for inf in self.informers.values():
+            inf.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        for inf in self.informers.values():
+            inf.stop()
+
+
+class PodGCController(Controller):
+    """Bounds the number of terminated pods kept around (reference
+    gc_controller.go: threshold via --terminated-pod-gc-threshold, oldest
+    deleted first)."""
+
+    name = "pod-gc"
+    KEY = "gc"
+
+    def __init__(self, client: RESTClient, threshold: int = 100):
+        super().__init__(workers=1)
+        self.client = client
+        self.threshold = threshold
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.pod_informer.add_event_handler(
+            on_add=lambda p: self._maybe_enqueue(p),
+            on_update=lambda old, new: self._maybe_enqueue(new))
+
+    def _maybe_enqueue(self, pod):
+        phase = pod.status.phase if pod.status else ""
+        if phase in (api.POD_SUCCEEDED, api.POD_FAILED):
+            self.enqueue(self.KEY)
+
+    def sync(self, key: str) -> None:
+        terminated = [p for p in self.pod_informer.store.list()
+                      if (p.status.phase if p.status else "") in
+                      (api.POD_SUCCEEDED, api.POD_FAILED)
+                      and p.metadata.deletion_timestamp is None]
+        excess = len(terminated) - self.threshold
+        if excess <= 0:
+            return
+        terminated.sort(key=lambda p: p.metadata.creation_timestamp or "")
+        for p in terminated[:excess]:
+            try:
+                self.client.delete("pods", p.metadata.name,
+                                   p.metadata.namespace)
+            except ApiError as e:
+                if not e.is_not_found:
+                    raise
+
+    def start(self):
+        self.pod_informer.run()
+        self.pod_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.pod_informer.stop()
+
+
+def _nn(obj) -> str:
+    m = obj.metadata
+    return f"{m.namespace}/{m.name}" if m.namespace else m.name
+
+
+def _is_namespaced(resource: str) -> bool:
+    from kubernetes_tpu.registry.generic import RESOURCES
+    rd = RESOURCES.get(resource)
+    return rd.namespaced if rd else True

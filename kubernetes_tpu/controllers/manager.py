@@ -14,11 +14,21 @@ from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderEle
 from kubernetes_tpu.controllers.daemonset_controller import DaemonSetController
 from kubernetes_tpu.controllers.deployment_controller import DeploymentController
 from kubernetes_tpu.controllers.endpoints_controller import EndpointsController
+from kubernetes_tpu.controllers.garbagecollector import (
+    GarbageCollector, PodGCController,
+)
 from kubernetes_tpu.controllers.job_controller import JobController
 from kubernetes_tpu.controllers.namespace_controller import NamespaceController
 from kubernetes_tpu.controllers.node_controller import NodeController
+from kubernetes_tpu.controllers.podautoscaler import HorizontalController
 from kubernetes_tpu.controllers.replicaset_controller import ReplicaSetController
 from kubernetes_tpu.controllers.replication_controller import ReplicationManager
+from kubernetes_tpu.controllers.resourcequota_controller import (
+    ResourceQuotaController,
+)
+from kubernetes_tpu.controllers.serviceaccounts_controller import (
+    ServiceAccountsController, TokensController,
+)
 
 log = logging.getLogger("controller-manager")
 
@@ -46,6 +56,12 @@ class ControllerManager:
             EndpointsController(self.client),
             NodeController(self.client),
             NamespaceController(self.client),
+            ResourceQuotaController(self.client),
+            ServiceAccountsController(self.client),
+            TokensController(self.client),
+            GarbageCollector(self.client),
+            PodGCController(self.client),
+            HorizontalController(self.client),
         ]
         for c in self.controllers:
             c.start()

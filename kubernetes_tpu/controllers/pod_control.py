@@ -39,7 +39,10 @@ def pod_from_template(kind: str, owner, template: api.PodTemplateSpec,
             generate_name=f"{owner.metadata.name}-",
             namespace=owner.metadata.namespace,
             labels=labels,
-            annotations={api.ANN_CREATED_BY: created_by_annotation(kind, owner)}),
+            annotations={api.ANN_CREATED_BY: created_by_annotation(kind, owner)},
+            owner_references=[api.OwnerReference(
+                kind=kind, name=owner.metadata.name, uid=owner.metadata.uid,
+                controller=True)]),
         spec=spec)
 
 

@@ -1,0 +1,182 @@
+"""Horizontal pod autoscaler controller.
+
+Parity target: reference pkg/controller/podautoscaler/horizontal.go —
+periodically, for each HPA: read the target's scale subresource, compute the
+pods' average CPU utilization vs the target percentage, and set
+
+    desired = ceil(current * currentUtilization / targetUtilization)
+
+within a 10% tolerance band, clamped to [minReplicas, maxReplicas]
+(computeReplicasForCPUUtilization). The reference pulls utilization from
+heapster (metrics_client.go); here the metrics source is pluggable, with the
+default reading the per-pod cpu-utilization annotation that hollow kubelets
+(kubemark) publish."""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import List, Optional
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.apis import autoscaling
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.pod_control import is_pod_active
+from kubernetes_tpu.utils.timeutil import now_iso
+
+log = logging.getLogger("hpa-controller")
+
+# annotation a node agent (or test) publishes per pod: integer percent of
+# the pod's cpu request currently used
+ANN_CPU_UTILIZATION = "metrics.alpha.kubernetes.io/cpu-utilization"
+
+TOLERANCE = 0.1  # reference defaultTolerance
+DEFAULT_TARGET_UTILIZATION = 80
+
+KIND_TO_RESOURCE = {
+    "ReplicationController": "replicationcontrollers",
+    "ReplicaSet": "replicasets",
+    "Deployment": "deployments",
+}
+
+
+class AnnotationMetricsClient:
+    """Average the per-pod utilization annotations (stand-in for heapster)."""
+
+    def cpu_utilization(self, pods: List[api.Pod]) -> Optional[int]:
+        vals = []
+        for p in pods:
+            raw = (p.metadata.annotations or {}).get(ANN_CPU_UTILIZATION)
+            if raw is None:
+                continue
+            try:
+                vals.append(int(raw))
+            except ValueError:
+                continue
+        if not vals:
+            return None
+        return int(round(sum(vals) / len(vals)))
+
+
+class HorizontalController(Controller):
+    name = "horizontalpodautoscaler"
+
+    def __init__(self, client: RESTClient, metrics_client=None,
+                 sync_seconds: float = 15.0, workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.metrics = metrics_client or AnnotationMetricsClient()
+        self.sync_seconds = sync_seconds
+        self.hpa_informer = Informer(ListWatch(client, "horizontalpodautoscalers"))
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.hpa_informer.add_event_handler(
+            on_add=lambda h: self.enqueue(_key(h)),
+            on_update=lambda old, new: self.enqueue(_key(new)))
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        hpa = self.hpa_informer.store.get(key)
+        if hpa is None or hpa.spec is None:
+            return
+        try:
+            self._reconcile(hpa)
+        finally:
+            self.enqueue_after(key, self.sync_seconds)  # periodic resync
+
+    def _reconcile(self, hpa: autoscaling.HorizontalPodAutoscaler) -> None:
+        ref = hpa.spec.scale_target_ref
+        resource = KIND_TO_RESOURCE.get(ref.kind if ref else "")
+        if resource is None:
+            log.info("hpa %s: unsupported target kind %r", _key(hpa),
+                     ref.kind if ref else None)
+            return
+        ns = hpa.metadata.namespace
+        try:
+            scale = self.client.get_scale(resource, ref.name, ns)
+        except ApiError as e:
+            if e.is_not_found:
+                return
+            raise
+        current = scale.status.replicas if scale.status else 0
+        if current == 0:
+            # replicas==0 means autoscaling is deliberately disabled
+            # (reference horizontal.go: never scale a 0-replica target)
+            self._update_status(hpa, 0, 0, None, scaled=False)
+            return
+        selector = scale.status.selector if scale.status else None
+        if not selector:
+            # no selector -> we cannot attribute pods to the target; a nil
+            # map would otherwise match every pod in the namespace
+            log.info("hpa %s: target has no selector; skipping", _key(hpa))
+            return
+        target_util = (hpa.spec.target_cpu_utilization_percentage
+                       or DEFAULT_TARGET_UTILIZATION)
+
+        desired = current
+        sel = labelsel.selector_from_map(selector)
+        pods = [p for p in self.pod_informer.store.list()
+                if p.metadata.namespace == ns and is_pod_active(p)
+                and sel.matches(p.metadata.labels or {})]
+        current_util = self.metrics.cpu_utilization(pods)
+        if current_util is not None:
+            ratio = current_util / target_util
+            if abs(ratio - 1.0) > TOLERANCE:
+                desired = int(math.ceil(ratio * current))
+
+        min_r = hpa.spec.min_replicas or 1
+        desired = max(min_r, min(hpa.spec.max_replicas or desired, desired))
+
+        if desired != current:
+            sc = deep_copy(scale)
+            sc.spec.replicas = desired
+            try:
+                self.client.update_scale(resource, ref.name, ns, sc)
+            except ApiError as e:
+                if not e.is_conflict:
+                    raise
+                return  # retry at next resync on fresh state
+        self._update_status(hpa, current, desired, current_util,
+                            scaled=desired != current)
+
+    def _update_status(self, hpa, current: int, desired: int,
+                       current_util: Optional[int], scaled: bool) -> None:
+        st = hpa.status
+        if (st and st.current_replicas == current
+                and st.desired_replicas == desired
+                and st.current_cpu_utilization_percentage == current_util
+                and not scaled):
+            return
+        fresh = deep_copy(hpa)
+        fresh.status = autoscaling.HorizontalPodAutoscalerStatus(
+            current_replicas=current, desired_replicas=desired,
+            current_cpu_utilization_percentage=current_util,
+            last_scale_time=now_iso() if scaled
+            else (st.last_scale_time if st else None))
+        try:
+            self.client.update_status("horizontalpodautoscalers", fresh)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.hpa_informer.run()
+        self.pod_informer.run()
+        self.hpa_informer.wait_for_sync()
+        self.pod_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.hpa_informer.stop()
+        self.pod_informer.stop()
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"

@@ -1,0 +1,115 @@
+"""ResourceQuota controller: keep quota status.used consistent with reality.
+
+Parity target: reference pkg/controller/resourcequota/resource_quota_controller.go
+— the admission plugin books usage optimistically at request time; this
+controller is the reconciler that recalculates true usage from the live
+objects (full recalculation per quota key) and replenishes quota when
+resources are deleted (replenishment informers enqueue the namespace's
+quotas). Shares the evaluator logic with the admission plugin
+(admission/plugins.py quota_usage_of)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+from kubernetes_tpu.admission.plugins import (
+    _COUNT_KEYS, format_usage, quota_usage_of,
+)
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.pod_control import is_pod_active
+
+log = logging.getLogger("resourcequota-controller")
+
+# resources whose churn changes quota usage (reference replenishment controllers)
+TRACKED = tuple(_COUNT_KEYS)
+
+
+class ResourceQuotaController(Controller):
+    name = "resourcequota"
+
+    def __init__(self, client: RESTClient, workers: int = 2,
+                 resync_seconds: float = 30.0):
+        super().__init__(workers)
+        self.client = client
+        self.resync_seconds = resync_seconds
+        self.quota_informer = Informer(ListWatch(client, "resourcequotas"))
+        self.quota_informer.add_event_handler(
+            on_add=lambda q: self.enqueue(_key(q)),
+            on_update=lambda old, new: self.enqueue(_key(new)))
+        self.tracked_informers: Dict[str, Informer] = {}
+        for res in TRACKED:
+            inf = Informer(ListWatch(client, res))
+            self.tracked_informers[res] = inf
+            inf.add_event_handler(
+                on_add=lambda obj: self._replenish(obj),
+                # updates matter too: a pod reaching Succeeded/Failed releases
+                # its quota without being deleted
+                on_update=lambda old, new: self._replenish(new),
+                on_delete=lambda obj: self._replenish(obj))
+
+    def _replenish(self, obj):
+        ns = obj.metadata.namespace if obj.metadata else ""
+        if not ns:
+            return
+        for q in self.quota_informer.store.list():
+            if q.metadata.namespace == ns:
+                self.enqueue(_key(q))
+
+    # --- reconcile -----------------------------------------------------------
+
+    def _calculate_usage(self, ns: str, hard: Dict[str, str]) -> Dict[str, int]:
+        used: Dict[str, int] = {k: 0 for k in hard}
+        for res, inf in self.tracked_informers.items():
+            for obj in inf.store.list():
+                if obj.metadata.namespace != ns:
+                    continue
+                if res == "pods" and not is_pod_active(obj):
+                    continue  # terminated pods release their quota
+                for k, v in quota_usage_of(res, obj).items():
+                    if k in used:
+                        used[k] += v
+        return used
+
+    def sync(self, key: str) -> None:
+        quota = self.quota_informer.store.get(key)
+        if quota is None:
+            return
+        hard = (quota.spec.hard if quota.spec else None) or {}
+        used = self._calculate_usage(quota.metadata.namespace, hard)
+        used_str = {k: format_usage(k, v) for k, v in used.items()}
+        st = quota.status
+        if st and st.hard == hard and st.used == used_str:
+            self.enqueue_after(key, self.resync_seconds)
+            return
+        fresh = deep_copy(quota)
+        fresh.status = api.ResourceQuotaStatus(hard=dict(hard), used=used_str)
+        try:
+            self.client.update_status("resourcequotas", fresh)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+        self.enqueue_after(key, self.resync_seconds)
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        infs = [self.quota_informer, *self.tracked_informers.values()]
+        for inf in infs:
+            inf.run()
+        for inf in infs:
+            inf.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        for inf in [self.quota_informer, *self.tracked_informers.values()]:
+            inf.stop()
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"

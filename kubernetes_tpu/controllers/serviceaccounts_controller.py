@@ -1,0 +1,217 @@
+"""ServiceAccount + token controllers.
+
+Parity targets:
+  - ServiceAccountsController (reference pkg/controller/serviceaccount/
+    serviceaccounts_controller.go): ensure every active namespace has the
+    "default" ServiceAccount; recreate it if deleted.
+  - TokensController (reference pkg/controller/serviceaccount/
+    tokens_controller.go): every ServiceAccount gets a
+    kubernetes.io/service-account-token Secret carrying a signed token,
+    referenced from sa.secrets; secrets of deleted SAs are cleaned up.
+    Token generation mirrors the JWT layout the reference produces via
+    pkg/serviceaccount/jwt.go, HMAC-signed here instead of RSA."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import logging
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+
+log = logging.getLogger("serviceaccount-controller")
+
+DEFAULT_SA = "default"
+
+
+class ServiceAccountsController(Controller):
+    """Namespace -> ensure the managed service accounts exist."""
+
+    name = "serviceaccount"
+
+    def __init__(self, client: RESTClient, workers: int = 1,
+                 names=(DEFAULT_SA,)):
+        super().__init__(workers)
+        self.client = client
+        self.names = tuple(names)
+        self.ns_informer = Informer(ListWatch(client, "namespaces"))
+        self.sa_informer = Informer(ListWatch(client, "serviceaccounts"))
+        self.ns_informer.add_event_handler(
+            on_add=lambda ns: self.enqueue(ns.metadata.name),
+            on_update=lambda old, new: self.enqueue(new.metadata.name))
+        self.sa_informer.add_event_handler(
+            on_delete=lambda sa: self.enqueue(sa.metadata.namespace))
+
+    def sync(self, key: str) -> None:
+        ns = self.ns_informer.store.get(key)
+        if ns is None:
+            return
+        if ns.status and ns.status.phase == "Terminating":
+            return
+        for name in self.names:
+            if self.sa_informer.store.get(f"{key}/{name}") is not None:
+                continue
+            try:
+                self.client.create("serviceaccounts", api.ServiceAccount(
+                    metadata=api.ObjectMeta(name=name, namespace=key)), key)
+            except ApiError as e:
+                if not e.is_conflict:  # already exists: informer lag
+                    raise
+
+    def start(self):
+        self.ns_informer.run()
+        self.sa_informer.run()
+        self.ns_informer.wait_for_sync()
+        self.sa_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.ns_informer.stop()
+        self.sa_informer.stop()
+
+
+def generate_token(signing_key: bytes, namespace: str, sa_name: str,
+                   sa_uid: str, secret_name: str) -> str:
+    """Compact JWT (header.claims.signature), HMAC-SHA256 signed. Claims match
+    the reference's legacy service-account claims (pkg/serviceaccount/jwt.go:
+    iss kubernetes/serviceaccount + namespace/name/uid/secret-name)."""
+    def b64(obj) -> str:
+        raw = json.dumps(obj, separators=(",", ":"), sort_keys=True).encode()
+        return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+    header = {"alg": "HS256", "typ": "JWT"}
+    claims = {
+        "iss": "kubernetes/serviceaccount",
+        "kubernetes.io/serviceaccount/namespace": namespace,
+        "kubernetes.io/serviceaccount/secret.name": secret_name,
+        "kubernetes.io/serviceaccount/service-account.name": sa_name,
+        "kubernetes.io/serviceaccount/service-account.uid": sa_uid,
+        "sub": f"system:serviceaccount:{namespace}:{sa_name}",
+    }
+    signing_input = f"{b64(header)}.{b64(claims)}"
+    sig = hmac.new(signing_key, signing_input.encode(), hashlib.sha256).digest()
+    return f"{signing_input}." + \
+        base64.urlsafe_b64encode(sig).rstrip(b"=").decode()
+
+
+class TokensController(Controller):
+    name = "serviceaccount-tokens"
+
+    def __init__(self, client: RESTClient, signing_key: bytes = b"dev-signing-key",
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.signing_key = signing_key
+        self.sa_informer = Informer(ListWatch(client, "serviceaccounts"))
+        self.secret_informer = Informer(ListWatch(client, "secrets"))
+        self.sa_informer.add_event_handler(
+            on_add=lambda sa: self.enqueue(_key(sa)),
+            on_update=lambda old, new: self.enqueue(_key(new)),
+            on_delete=self._sa_deleted)
+        self.secret_informer.add_event_handler(
+            on_delete=self._secret_deleted)
+
+    def _sa_deleted(self, sa):
+        # hand cleanup to the workqueue: informer handlers must not block on
+        # API calls, and the queue gives us retry on transient failures
+        self.enqueue(f"cleanup|{_key(sa)}")
+
+    def _secret_deleted(self, secret):
+        ann = (secret.metadata.annotations or {})
+        sa_name = ann.get(api.ANN_SERVICE_ACCOUNT_NAME)
+        if sa_name:
+            self.enqueue(f"{secret.metadata.namespace}/{sa_name}")
+
+    def _token_secrets_of(self, sa):
+        out = []
+        for s in self.secret_informer.store.list():
+            if s.metadata.namespace != sa.metadata.namespace:
+                continue
+            if s.type != api.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN:
+                continue
+            ann = s.metadata.annotations or {}
+            if ann.get(api.ANN_SERVICE_ACCOUNT_NAME) == sa.metadata.name:
+                out.append(s)
+        return out
+
+    def sync(self, key: str) -> None:
+        if key.startswith("cleanup|"):
+            self._cleanup_tokens(key.split("|", 1)[1])
+            return
+        sa = self.sa_informer.store.get(key)
+        if sa is None:
+            return
+        ns = sa.metadata.namespace
+        secret_name = f"{sa.metadata.name}-token"
+        if not self._token_secrets_of(sa):
+            token = generate_token(self.signing_key, ns, sa.metadata.name,
+                                   sa.metadata.uid, secret_name)
+            secret = api.Secret(
+                metadata=api.ObjectMeta(
+                    name=secret_name, namespace=ns,
+                    annotations={
+                        api.ANN_SERVICE_ACCOUNT_NAME: sa.metadata.name,
+                        api.ANN_SERVICE_ACCOUNT_UID: sa.metadata.uid}),
+                type=api.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN,
+                data={"token": base64.b64encode(token.encode()).decode()})
+            try:
+                self.client.create("secrets", secret, ns)
+            except ApiError as e:
+                if not e.is_conflict:
+                    raise
+        # link the secret from the service account even when the secret was
+        # created by an earlier sync whose update step failed (conflicts
+        # propagate so the rate-limited requeue retries the link)
+        if not any(r.name == secret_name for r in (sa.secrets or [])):
+            try:
+                fresh = deep_copy(self.client.get("serviceaccounts",
+                                                  sa.metadata.name, ns))
+                refs = list(fresh.secrets or [])
+                if not any(r.name == secret_name for r in refs):
+                    refs.append(api.ObjectReference(
+                        kind="Secret", namespace=ns, name=secret_name))
+                    fresh.secrets = refs
+                    self.client.update("serviceaccounts", fresh, ns)
+            except ApiError as e:
+                if e.is_not_found:
+                    return  # SA vanished; cleanup path handles the secret
+                raise  # incl. conflicts: requeue retries the link
+
+    def _cleanup_tokens(self, nn: str) -> None:
+        ns, name = nn.split("/", 1)
+        for s in self.secret_informer.store.list():
+            if s.metadata.namespace != ns:
+                continue
+            if s.type != api.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN:
+                continue
+            if (s.metadata.annotations or {}).get(
+                    api.ANN_SERVICE_ACCOUNT_NAME) != name:
+                continue
+            try:
+                self.client.delete("secrets", s.metadata.name, ns)
+            except ApiError as e:
+                if not e.is_not_found:
+                    raise
+
+    def start(self):
+        self.sa_informer.run()
+        self.secret_informer.run()
+        self.sa_informer.wait_for_sync()
+        self.secret_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.sa_informer.stop()
+        self.secret_informer.stop()
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"

@@ -1,0 +1,279 @@
+"""ResourceQuota, ServiceAccount/Tokens, GarbageCollector, PodGC, HPA
+controllers (reference pkg/controller/{resourcequota,serviceaccount,
+garbagecollector,gc,podautoscaler} behaviors)."""
+
+import base64
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apis import autoscaling, extensions as ext
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.controllers.deployment_controller import DeploymentController
+from kubernetes_tpu.controllers.garbagecollector import (
+    GarbageCollector, PodGCController,
+)
+from kubernetes_tpu.controllers.podautoscaler import (
+    ANN_CPU_UTILIZATION, HorizontalController,
+)
+from kubernetes_tpu.controllers.replicaset_controller import ReplicaSetController
+from kubernetes_tpu.controllers.resourcequota_controller import (
+    ResourceQuotaController,
+)
+from kubernetes_tpu.controllers.serviceaccounts_controller import (
+    ServiceAccountsController, TokensController, generate_token,
+)
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=2000, burst=2000)
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:
+            pass
+        time.sleep(0.03)
+    raise AssertionError("condition not met")
+
+
+def _template(labels):
+    return api.PodTemplateSpec(
+        metadata=api.ObjectMeta(labels=dict(labels)),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="pause")]))
+
+
+def _pod(name, labels=None, cpu="100m", mem="64Mi"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                labels=labels or {}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="pause",
+            resources=api.ResourceRequirements(
+                requests={"cpu": cpu, "memory": mem}))]))
+
+
+class TestResourceQuotaController:
+    def test_recalculates_usage(self, client):
+        client.create("resourcequotas", api.ResourceQuota(
+            metadata=api.ObjectMeta(name="quota", namespace="default"),
+            spec=api.ResourceQuotaSpec(hard={"pods": "10", "cpu": "2"})),
+            "default")
+        ctrl = ResourceQuotaController(client, resync_seconds=0.2)
+        ctrl.start()
+        try:
+            for i in range(3):
+                client.create("pods", _pod(f"p{i}", cpu="100m"), "default")
+
+            def usage_ok():
+                q = client.get("resourcequotas", "quota", "default")
+                u = (q.status.used or {}) if q.status else {}
+                return u.get("pods") == "3" and u.get("cpu") == "300m"
+            _wait(usage_ok)
+
+            # deletion replenishes
+            client.delete("pods", "p0", "default")
+            _wait(lambda: (client.get("resourcequotas", "quota", "default")
+                           .status.used or {}).get("pods") == "2")
+        finally:
+            ctrl.stop()
+
+
+class TestServiceAccountControllers:
+    def test_default_sa_created_and_recreated(self, client):
+        sac = ServiceAccountsController(client)
+        sac.start()
+        try:
+            client.create("namespaces", api.Namespace(
+                metadata=api.ObjectMeta(name="team-a")))
+            _wait(lambda: client.get("serviceaccounts", "default", "team-a"))
+            client.delete("serviceaccounts", "default", "team-a")
+            _wait(lambda: client.get("serviceaccounts", "default", "team-a"))
+        finally:
+            sac.stop()
+
+    def test_token_secret_created_and_linked(self, client):
+        tc = TokensController(client, signing_key=b"test-key")
+        tc.start()
+        try:
+            client.create("serviceaccounts", api.ServiceAccount(
+                metadata=api.ObjectMeta(name="robot", namespace="default")),
+                "default")
+            _wait(lambda: client.get("secrets", "robot-token", "default"))
+            secret = client.get("secrets", "robot-token", "default")
+            assert secret.type == api.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN
+            token = base64.b64decode(secret.data["token"]).decode()
+            assert token.count(".") == 2  # compact JWT
+            # sa.secrets references the token secret
+            _wait(lambda: any(r.name == "robot-token" for r in
+                              (client.get("serviceaccounts", "robot",
+                                          "default").secrets or [])))
+        finally:
+            tc.stop()
+
+    def test_token_is_deterministic_hmac(self):
+        t1 = generate_token(b"k", "ns", "sa", "uid1", "sa-token")
+        t2 = generate_token(b"k", "ns", "sa", "uid1", "sa-token")
+        assert t1 == t2
+        assert generate_token(b"other", "ns", "sa", "uid1", "sa-token") != t1
+
+
+class TestGarbageCollector:
+    def test_cascade_deployment_to_pods(self, client):
+        dc = DeploymentController(client)
+        rsc = ReplicaSetController(client)
+        gc = GarbageCollector(client)
+        dc.start()
+        rsc.start()
+        gc.start()
+        try:
+            d = ext.Deployment(
+                metadata=api.ObjectMeta(name="doomed", namespace="default"),
+                spec=ext.DeploymentSpec(
+                    replicas=2,
+                    selector=api.LabelSelector(match_labels={"app": "doomed"}),
+                    template=_template({"app": "doomed"})))
+            client.create("deployments", d, "default")
+            _wait(lambda: len(client.list("pods", "default",
+                                          label_selector="app=doomed")[0]) == 2)
+            # pods + RS carry ownerReferences
+            rs = client.list("replicasets", "default")[0][0]
+            assert rs.metadata.owner_references[0].kind == "Deployment"
+            p = client.list("pods", "default",
+                            label_selector="app=doomed")[0][0]
+            assert p.metadata.owner_references[0].kind == "ReplicaSet"
+
+            # stop the managing controllers so only GC acts, then delete
+            dc.stop()
+            rsc.stop()
+            client.delete("deployments", "doomed", "default")
+            _wait(lambda: len(client.list("replicasets", "default")[0]) == 0,
+                  timeout=15)
+            _wait(lambda: len(client.list("pods", "default",
+                                          label_selector="app=doomed")[0]) == 0,
+                  timeout=15)
+        finally:
+            gc.stop()
+
+    def test_orphan_without_refs_untouched(self, client):
+        gc = GarbageCollector(client)
+        gc.start()
+        try:
+            client.create("pods", _pod("standalone"), "default")
+            time.sleep(0.5)
+            assert client.get("pods", "standalone", "default")
+        finally:
+            gc.stop()
+
+
+class TestPodGC:
+    def test_deletes_oldest_terminated_over_threshold(self, client):
+        for i in range(5):
+            p = _pod(f"dead-{i}")
+            created = client.create("pods", p, "default")
+            created.status = api.PodStatus(phase=api.POD_SUCCEEDED)
+            client.update_status("pods", created)
+        ctrl = PodGCController(client, threshold=2)
+        ctrl.start()
+        try:
+            ctrl.enqueue(ctrl.KEY)
+            _wait(lambda: len(client.list("pods", "default")[0]) == 2)
+        finally:
+            ctrl.stop()
+
+
+class TestHorizontalController:
+    def test_scales_up_on_high_utilization(self, client):
+        rsc = ReplicaSetController(client)
+        hpa_ctrl = HorizontalController(client, sync_seconds=0.2)
+        rsc.start()
+        hpa_ctrl.start()
+        try:
+            rs = api.ReplicaSet(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ReplicaSetSpec(
+                    replicas=2,
+                    selector=api.LabelSelector(match_labels={"app": "web"}),
+                    template=_template({"app": "web"})))
+            client.create("replicasets", rs, "default")
+            _wait(lambda: len(client.list("pods", "default",
+                                          label_selector="app=web")[0]) == 2)
+            _wait(lambda: client.get("replicasets", "web", "default")
+                  .status.replicas == 2)
+
+            client.create("horizontalpodautoscalers",
+                          autoscaling.HorizontalPodAutoscaler(
+                              metadata=api.ObjectMeta(name="web-hpa",
+                                                      namespace="default"),
+                              spec=autoscaling.HorizontalPodAutoscalerSpec(
+                                  scale_target_ref=autoscaling
+                                  .CrossVersionObjectReference(
+                                      kind="ReplicaSet", name="web"),
+                                  min_replicas=1, max_replicas=10,
+                                  target_cpu_utilization_percentage=50)),
+                          "default")
+
+            # pods report 100% utilization -> desired = ceil(2 * 100/50) = 4
+            for p in client.list("pods", "default",
+                                 label_selector="app=web")[0]:
+                p.metadata.annotations = {ANN_CPU_UTILIZATION: "100"}
+                client.update("pods", p, "default")
+
+            _wait(lambda: client.get("replicasets", "web", "default")
+                  .spec.replicas >= 4, timeout=15)
+            hpa = client.get("horizontalpodautoscalers", "web-hpa", "default")
+            assert hpa.status.desired_replicas >= 4
+        finally:
+            hpa_ctrl.stop()
+            rsc.stop()
+
+    def test_within_tolerance_no_scale(self, client):
+        hpa_ctrl = HorizontalController(client, sync_seconds=0.2)
+        rsc = ReplicaSetController(client)
+        rsc.start()
+        hpa_ctrl.start()
+        try:
+            rs = api.ReplicaSet(
+                metadata=api.ObjectMeta(name="steady", namespace="default"),
+                spec=api.ReplicaSetSpec(
+                    replicas=2,
+                    selector=api.LabelSelector(match_labels={"app": "steady"}),
+                    template=_template({"app": "steady"})))
+            client.create("replicasets", rs, "default")
+            _wait(lambda: client.get("replicasets", "steady", "default")
+                  .status.replicas == 2)
+            client.create("horizontalpodautoscalers",
+                          autoscaling.HorizontalPodAutoscaler(
+                              metadata=api.ObjectMeta(name="steady-hpa",
+                                                      namespace="default"),
+                              spec=autoscaling.HorizontalPodAutoscalerSpec(
+                                  scale_target_ref=autoscaling
+                                  .CrossVersionObjectReference(
+                                      kind="ReplicaSet", name="steady"),
+                                  min_replicas=1, max_replicas=10,
+                                  target_cpu_utilization_percentage=50)),
+                          "default")
+            for p in client.list("pods", "default",
+                                 label_selector="app=steady")[0]:
+                p.metadata.annotations = {ANN_CPU_UTILIZATION: "52"}  # within 10%
+                client.update("pods", p, "default")
+            time.sleep(1.0)
+            assert client.get("replicasets", "steady", "default") \
+                .spec.replicas == 2
+        finally:
+            hpa_ctrl.stop()
+            rsc.stop()
