@@ -27,7 +27,8 @@ log = logging.getLogger("garbage-collector")
 
 # resources the collector watches, and the kind an ownerReference names
 DEFAULT_MONITORED = ("pods", "replicasets", "replicationcontrollers",
-                     "deployments", "jobs", "daemonsets")
+                     "deployments", "jobs", "daemonsets", "petsets",
+                     "scheduledjobs")
 KIND_TO_RESOURCE = {
     "Pod": "pods",
     "ReplicaSet": "replicasets",
@@ -36,6 +37,7 @@ KIND_TO_RESOURCE = {
     "Job": "jobs",
     "DaemonSet": "daemonsets",
     "PetSet": "petsets",
+    "ScheduledJob": "scheduledjobs",
 }
 
 

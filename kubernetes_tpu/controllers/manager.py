@@ -20,11 +20,18 @@ from kubernetes_tpu.controllers.garbagecollector import (
 from kubernetes_tpu.controllers.job_controller import JobController
 from kubernetes_tpu.controllers.namespace_controller import NamespaceController
 from kubernetes_tpu.controllers.node_controller import NodeController
+from kubernetes_tpu.controllers.persistentvolume_controller import (
+    PersistentVolumeController,
+)
+from kubernetes_tpu.controllers.petset_controller import PetSetController
 from kubernetes_tpu.controllers.podautoscaler import HorizontalController
 from kubernetes_tpu.controllers.replicaset_controller import ReplicaSetController
 from kubernetes_tpu.controllers.replication_controller import ReplicationManager
 from kubernetes_tpu.controllers.resourcequota_controller import (
     ResourceQuotaController,
+)
+from kubernetes_tpu.controllers.scheduledjob_controller import (
+    ScheduledJobController,
 )
 from kubernetes_tpu.controllers.serviceaccounts_controller import (
     ServiceAccountsController, TokensController,
@@ -62,6 +69,9 @@ class ControllerManager:
             GarbageCollector(self.client),
             PodGCController(self.client),
             HorizontalController(self.client),
+            PersistentVolumeController(self.client),
+            PetSetController(self.client),
+            ScheduledJobController(self.client),
         ]
         for c in self.controllers:
             c.start()

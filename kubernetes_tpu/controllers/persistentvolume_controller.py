@@ -1,0 +1,262 @@
+"""PersistentVolume binder/reclaimer/provisioner.
+
+Parity target: reference pkg/controller/persistentvolume (binder +
+recycler/deleter + provisioner split across controllers in 1.3):
+
+  - bind: a Pending claim is matched to the smallest Available volume whose
+    capacity and accessModes satisfy the request (or an exact
+    spec.volumeName); both sides record the bind (pv.spec.claimRef /
+    pvc.spec.volumeName) and go phase Bound
+  - reclaim: when the bound claim disappears the volume goes Released, then
+    per persistentVolumeReclaimPolicy: Retain keeps it Released, Recycle
+    scrubs the claimRef and returns it to Available, Delete removes it
+  - provision: a claim carrying the alpha storage-class annotation gets a
+    volume created on demand when nothing matches (pluggable provisioner)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+
+log = logging.getLogger("pv-controller")
+
+# phases (reference pkg/api/types.go PersistentVolumePhase / ClaimPhase)
+VOLUME_AVAILABLE = "Available"
+VOLUME_BOUND = "Bound"
+VOLUME_RELEASED = "Released"
+VOLUME_FAILED = "Failed"
+CLAIM_PENDING = "Pending"
+CLAIM_BOUND = "Bound"
+
+RECLAIM_RETAIN = "Retain"
+RECLAIM_RECYCLE = "Recycle"
+RECLAIM_DELETE = "Delete"
+
+ANN_STORAGE_CLASS = "volume.alpha.kubernetes.io/storage-class"
+
+
+def claim_request_bytes(pvc: api.PersistentVolumeClaim) -> int:
+    req = (pvc.spec.resources.requests
+           if pvc.spec and pvc.spec.resources else None) or {}
+    return parse_quantity(req.get("storage", "0"))
+
+
+def volume_capacity_bytes(pv: api.PersistentVolume) -> int:
+    cap = (pv.spec.capacity if pv.spec else None) or {}
+    return parse_quantity(cap.get("storage", "0"))
+
+
+def access_modes_satisfy(pv: api.PersistentVolume,
+                         pvc: api.PersistentVolumeClaim) -> bool:
+    want = set((pvc.spec.access_modes if pvc.spec else None) or [])
+    have = set((pv.spec.access_modes if pv.spec else None) or [])
+    return want <= have
+
+
+class PersistentVolumeController(Controller):
+    """One workqueue for both kinds: keys are "pv|name" / "pvc|ns/name"."""
+
+    name = "persistentvolume"
+
+    def __init__(self, client: RESTClient, workers: int = 1,
+                 provisioner: Optional[Callable] = None):
+        super().__init__(workers)
+        self.client = client
+        self.provisioner = provisioner
+        self.pv_informer = Informer(ListWatch(client, "persistentvolumes"))
+        self.pvc_informer = Informer(ListWatch(client, "persistentvolumeclaims"))
+        self.pv_informer.add_event_handler(
+            on_add=lambda pv: self.enqueue(f"pv|{pv.metadata.name}"),
+            on_update=lambda o, n: self.enqueue(f"pv|{n.metadata.name}"),
+            on_delete=lambda pv: self._requeue_pending_claims())
+        self.pvc_informer.add_event_handler(
+            on_add=lambda c: self.enqueue(f"pvc|{_nn(c)}"),
+            on_update=lambda o, n: self.enqueue(f"pvc|{_nn(n)}"),
+            on_delete=self._claim_deleted)
+
+    def _requeue_pending_claims(self):
+        for c in self.pvc_informer.store.list():
+            if (c.status.phase if c.status else "") != CLAIM_BOUND:
+                self.enqueue(f"pvc|{_nn(c)}")
+
+    def _claim_deleted(self, pvc):
+        # release the volume this claim was bound to
+        vol_name = pvc.spec.volume_name if pvc.spec else ""
+        if vol_name:
+            self.enqueue(f"pv|{vol_name}")
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        kind, rest = key.split("|", 1)
+        if kind == "pvc":
+            self._sync_claim(rest)
+        else:
+            self._sync_volume(rest)
+
+    # claims ------------------------------------------------------------------
+
+    def _sync_claim(self, nn: str) -> None:
+        pvc = self.pvc_informer.store.get(nn)
+        if pvc is None:
+            return
+        phase = pvc.status.phase if pvc.status else ""
+        if phase == CLAIM_BOUND:
+            return
+        match = self._find_match(pvc)
+        if match is None and self.provisioner is not None and \
+                (pvc.metadata.annotations or {}).get(ANN_STORAGE_CLASS):
+            pv = self.provisioner(pvc)
+            if pv is not None:
+                try:
+                    match = self.client.create("persistentvolumes", pv)
+                except ApiError as e:
+                    if not e.is_conflict:
+                        raise
+                    match = self.client.get("persistentvolumes",
+                                            pv.metadata.name)
+        if match is None:
+            # stay Pending; new volumes requeue us
+            if phase != CLAIM_PENDING:
+                self._set_claim_phase(pvc, CLAIM_PENDING)
+            return
+        self._bind(match, pvc)
+
+    def _find_match(self, pvc) -> Optional[api.PersistentVolume]:
+        want_name = pvc.spec.volume_name if pvc.spec else ""
+        want_bytes = claim_request_bytes(pvc)
+        candidates: List[api.PersistentVolume] = []
+        for pv in self.pv_informer.store.list():
+            phase = pv.status.phase if pv.status else ""
+            claim_ref = pv.spec.claim_ref if pv.spec else None
+            if claim_ref is not None:
+                # pre-bound volume: only its designated claim may take it —
+                # and only the SAME claim instance (uid match), else a
+                # recreated claim would inherit a retained volume's data
+                if (claim_ref.namespace == pvc.metadata.namespace
+                        and claim_ref.name == pvc.metadata.name
+                        and (not claim_ref.uid
+                             or claim_ref.uid == pvc.metadata.uid)):
+                    return pv
+                continue
+            if phase not in ("", VOLUME_AVAILABLE):
+                continue
+            if want_name and pv.metadata.name != want_name:
+                continue
+            if not access_modes_satisfy(pv, pvc):
+                continue
+            if volume_capacity_bytes(pv) < want_bytes:
+                continue
+            candidates.append(pv)
+        if not candidates:
+            return None
+        # smallest satisfying volume wins (reference matchVolume sort)
+        return min(candidates, key=volume_capacity_bytes)
+
+    def _bind(self, pv, pvc) -> None:
+        fresh_pv = deep_copy(pv)
+        fresh_pv.spec.claim_ref = api.ObjectReference(
+            kind="PersistentVolumeClaim",
+            namespace=pvc.metadata.namespace, name=pvc.metadata.name,
+            uid=pvc.metadata.uid)
+        fresh_pv.status = api.PersistentVolumeStatus(phase=VOLUME_BOUND)
+        # conflicts propagate: the requeue re-matches on fresh state
+        self.client.update("persistentvolumes", fresh_pv)
+        fresh_pvc = deep_copy(pvc)
+        fresh_pvc.spec.volume_name = pv.metadata.name
+        fresh_pvc.status = api.PersistentVolumeClaimStatus(phase=CLAIM_BOUND)
+        try:
+            self.client.update("persistentvolumeclaims", fresh_pvc,
+                               pvc.metadata.namespace)
+        except ApiError as e:
+            if not e.is_not_found:
+                raise
+            # claim vanished mid-bind: the volume sync will release it
+        log.info("pv: bound %s -> %s/%s", pv.metadata.name,
+                 pvc.metadata.namespace, pvc.metadata.name)
+
+    def _set_claim_phase(self, pvc, phase: str) -> None:
+        fresh = deep_copy(pvc)
+        fresh.status = api.PersistentVolumeClaimStatus(phase=phase)
+        try:
+            self.client.update("persistentvolumeclaims", fresh,
+                               pvc.metadata.namespace)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+
+    # volumes -----------------------------------------------------------------
+
+    def _sync_volume(self, name: str) -> None:
+        pv = self.pv_informer.store.get(name)
+        if pv is None:
+            return
+        claim_ref = pv.spec.claim_ref if pv.spec else None
+        phase = pv.status.phase if pv.status else ""
+        if claim_ref is None:
+            if phase not in (VOLUME_AVAILABLE,):
+                self._set_volume_phase(pv, VOLUME_AVAILABLE)
+                self._requeue_pending_claims()
+            return
+        # bound (or pre-bound): does the claim still exist?
+        claim = self.pvc_informer.store.get(
+            f"{claim_ref.namespace}/{claim_ref.name}")
+        if claim is not None and (not claim_ref.uid
+                                  or claim.metadata.uid == claim_ref.uid):
+            if phase != VOLUME_BOUND and (claim.spec and
+                                          claim.spec.volume_name == name):
+                self._set_volume_phase(pv, VOLUME_BOUND)
+            return
+        # claim is gone -> reclaim
+        policy = (pv.spec.persistent_volume_reclaim_policy
+                  if pv.spec else "") or RECLAIM_RETAIN
+        if policy == RECLAIM_DELETE:
+            try:
+                self.client.delete("persistentvolumes", name)
+            except ApiError as e:
+                if not e.is_not_found:
+                    raise
+        elif policy == RECLAIM_RECYCLE:
+            fresh = deep_copy(pv)
+            fresh.spec.claim_ref = None
+            fresh.status = api.PersistentVolumeStatus(phase=VOLUME_AVAILABLE)
+            self.client.update("persistentvolumes", fresh)
+            self._requeue_pending_claims()
+        else:  # Retain
+            if phase != VOLUME_RELEASED:
+                self._set_volume_phase(pv, VOLUME_RELEASED)
+
+    def _set_volume_phase(self, pv, phase: str) -> None:
+        fresh = deep_copy(pv)
+        fresh.status = api.PersistentVolumeStatus(phase=phase)
+        try:
+            self.client.update("persistentvolumes", fresh)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.pv_informer.run()
+        self.pvc_informer.run()
+        self.pv_informer.wait_for_sync()
+        self.pvc_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.pv_informer.stop()
+        self.pvc_informer.stop()
+
+
+def _nn(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"

@@ -1,0 +1,202 @@
+"""PetSet controller: stateful pods with stable, ordinal identity.
+
+Parity target: reference pkg/controller/petset (pet_set.go, pet.go,
+identity_mappers.go) — pods named {set}-0 … {set}-{N-1}; creation strictly in
+ordinal order, each pet gated on its predecessor being Running+Ready; scale
+down removes the highest ordinal first; each volumeClaimTemplate yields a
+per-pet PVC named {template}-{pet} that the pet mounts; pet hostname/subdomain
+come from the governing service (spec.serviceName)."""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Dict, List
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.apis import apps
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.pod_control import (
+    is_pod_active, is_pod_ready, selector_for,
+)
+
+log = logging.getLogger("petset-controller")
+
+ANN_POD_NAME = "pod.alpha.kubernetes.io/name"
+ANN_SUBDOMAIN = "pod.alpha.kubernetes.io/subdomain"
+
+
+def pet_name(ps: apps.PetSet, ordinal: int) -> str:
+    return f"{ps.metadata.name}-{ordinal}"
+
+
+def pet_ordinal(ps: apps.PetSet, pod: api.Pod) -> int:
+    m = re.fullmatch(re.escape(ps.metadata.name) + r"-(\d+)",
+                     pod.metadata.name)
+    return int(m.group(1)) if m else -1
+
+
+class PetSetController(Controller):
+    name = "petset"
+
+    def __init__(self, client: RESTClient, workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.ps_informer = Informer(ListWatch(client, "petsets"))
+        self.pod_informer = Informer(ListWatch(client, "pods"))
+        self.ps_informer.add_event_handler(
+            on_add=lambda ps: self.enqueue(_key(ps)),
+            on_update=lambda old, new: self.enqueue(_key(new)))
+        self.pod_informer.add_event_handler(
+            on_add=self._pod_changed,
+            on_update=lambda old, new: self._pod_changed(new),
+            on_delete=self._pod_changed)
+
+    def _pod_changed(self, pod):
+        lbls = pod.metadata.labels or {}
+        for ps in self.ps_informer.store.list():
+            if (ps.metadata.namespace == pod.metadata.namespace
+                    and selector_for(ps).matches(lbls)):
+                self.enqueue(_key(ps))
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        ns, _ = key.split("/", 1)
+        ps = self.ps_informer.store.get(key)
+        if ps is None:
+            return
+        sel = selector_for(ps)
+        pets: Dict[int, api.Pod] = {}
+        for p in self.pod_informer.store.list():
+            if (p.metadata.namespace != ns
+                    or not sel.matches(p.metadata.labels or {})):
+                continue
+            o = pet_ordinal(ps, p)
+            if o < 0:
+                continue
+            if not is_pod_active(p):
+                # a terminated pet still occupies its ordinal name; delete it
+                # so the recreate below isn't a perpetual 409 (reference
+                # pet_set.go replaces failed pets)
+                if p.metadata.deletion_timestamp is None:
+                    try:
+                        self.client.delete("pods", p.metadata.name, ns)
+                    except ApiError as e:
+                        if not e.is_not_found:
+                            raise
+                continue
+            pets[o] = p
+        want = ps.spec.replicas or 0
+
+        # scale up: create the FIRST missing ordinal, but only if every lower
+        # ordinal is Running+Ready (sequential bring-up, pet_set.go syncPetSet)
+        for i in range(want):
+            pod = pets.get(i)
+            if pod is None:
+                self._create_pet(ps, i)
+                break
+            if not (_running(pod) and is_pod_ready(pod)):
+                break  # wait for this pet before creating successors
+        else:
+            # scale down: highest ordinal first, one at a time
+            extra = sorted((o for o in pets if o >= want), reverse=True)
+            if extra:
+                victim = pets[extra[0]]
+                try:
+                    self.client.delete("pods", victim.metadata.name, ns)
+                except ApiError as e:
+                    if not e.is_not_found:
+                        raise
+        self._update_status(ps, len([o for o in pets if o < want]))
+
+    def _create_pet(self, ps: apps.PetSet, ordinal: int) -> None:
+        ns = ps.metadata.namespace
+        name = pet_name(ps, ordinal)
+        tpl = ps.spec.template or api.PodTemplateSpec()
+        spec = deep_copy(tpl.spec) if tpl.spec else api.PodSpec(
+            containers=[api.Container(name="c", image="pause")])
+
+        # per-pet claims from volumeClaimTemplates; the pet's volumes point at
+        # them by the {template}-{pet} naming contract
+        volumes = list(spec.volumes or [])
+        for ct in ps.spec.volume_claim_templates or []:
+            claim_name = f"{ct.metadata.name}-{name}"
+            self._ensure_claim(ns, claim_name, ct)
+            volumes = [v for v in volumes if v.name != ct.metadata.name]
+            volumes.append(api.Volume(
+                name=ct.metadata.name,
+                persistent_volume_claim=api.PersistentVolumeClaimVolumeSource(
+                    claim_name=claim_name)))
+        spec.volumes = volumes or None
+
+        pod = api.Pod(
+            metadata=api.ObjectMeta(
+                name=name, namespace=ns,
+                labels=dict((tpl.metadata.labels if tpl.metadata else None)
+                            or {}),
+                annotations={ANN_POD_NAME: name,
+                             ANN_SUBDOMAIN: ps.spec.service_name or ""},
+                owner_references=[api.OwnerReference(
+                    kind="PetSet", name=ps.metadata.name,
+                    uid=ps.metadata.uid, controller=True)]),
+            spec=spec)
+        try:
+            self.client.create("pods", pod, ns)
+        except ApiError as e:
+            if not e.is_conflict:  # already exists: informer lag
+                raise
+
+    def _ensure_claim(self, ns: str, claim_name: str,
+                      template: api.PersistentVolumeClaim) -> None:
+        try:
+            self.client.get("persistentvolumeclaims", claim_name, ns)
+            return
+        except ApiError as e:
+            if not e.is_not_found:
+                raise
+        pvc = api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name=claim_name, namespace=ns),
+            spec=deep_copy(template.spec) if template.spec else
+            api.PersistentVolumeClaimSpec())
+        try:
+            self.client.create("persistentvolumeclaims", pvc, ns)
+        except ApiError as e:
+            if not e.is_conflict:
+                raise
+
+    def _update_status(self, ps, replicas: int) -> None:
+        if ps.status and ps.status.replicas == replicas:
+            return
+        fresh = deep_copy(ps)
+        fresh.status = apps.PetSetStatus(replicas=replicas)
+        try:
+            self.client.update_status("petsets", fresh)
+        except ApiError as e:
+            if not (e.is_not_found or e.is_conflict):
+                raise
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.ps_informer.run()
+        self.pod_informer.run()
+        self.ps_informer.wait_for_sync()
+        self.pod_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.ps_informer.stop()
+        self.pod_informer.stop()
+
+
+def _running(pod: api.Pod) -> bool:
+    return (pod.status.phase if pod.status else "") == api.POD_RUNNING
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"

@@ -1,0 +1,219 @@
+"""ScheduledJob (cron job) controller.
+
+Parity target: reference pkg/controller/scheduledjob (controller.go, utils.go)
+— every sync period, for each ScheduledJob: skip if suspended; find the most
+recent schedule time due since the last run (cron semantics via utils/cron);
+honor startingDeadlineSeconds; apply the concurrency policy (Allow runs
+alongside, Forbid skips while active, Replace deletes actives first); create
+the Job from spec.jobTemplate named {sj}-{scheduledEpochMinutes}; track it in
+status.active and prune finished jobs from that list."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.apis import batch
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.utils import cron
+from kubernetes_tpu.utils.timeutil import parse_iso
+
+log = logging.getLogger("scheduledjob-controller")
+
+
+def job_name_for(sj: batch.ScheduledJob, scheduled_epoch: float) -> str:
+    # deterministic name: re-creating the same scheduled run is a 409, which
+    # is how double-fires are deduped (reference getJobName)
+    return f"{sj.metadata.name}-{int(scheduled_epoch) // 60}"
+
+
+class ScheduledJobController(Controller):
+    name = "scheduledjob"
+
+    def __init__(self, client: RESTClient, workers: int = 1,
+                 sync_seconds: float = 10.0, clock=time.time):
+        super().__init__(workers)
+        self.client = client
+        self.sync_seconds = sync_seconds
+        self.clock = clock
+        self.sj_informer = Informer(ListWatch(client, "scheduledjobs"))
+        self.job_informer = Informer(ListWatch(client, "jobs"))
+        self.sj_informer.add_event_handler(
+            on_add=lambda sj: self.enqueue(_key(sj)),
+            on_update=lambda old, new: self.enqueue(_key(new)))
+        self.job_informer.add_event_handler(
+            on_update=lambda old, new: self._job_changed(new),
+            on_delete=self._job_changed)
+
+    def _job_changed(self, job):
+        refs = job.metadata.owner_references or []
+        for r in refs:
+            if r.kind == "ScheduledJob":
+                self.enqueue(f"{job.metadata.namespace}/{r.name}")
+
+    # --- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        sj = self.sj_informer.store.get(key)
+        if sj is None:
+            return
+        try:
+            self._reconcile(sj)
+        finally:
+            self.enqueue_after(key, self.sync_seconds)
+
+    def _reconcile(self, sj: batch.ScheduledJob) -> None:
+        ns = sj.metadata.namespace
+        active = self._prune_active(sj)
+        if sj.spec is None or sj.spec.suspend:
+            return
+        try:
+            sched = cron.parse(sj.spec.schedule)
+        except cron.CronParseError as e:
+            log.info("scheduledjob %s: bad schedule %r: %s", _key(sj),
+                     sj.spec.schedule, e)
+            return
+        now = self.clock()
+        last = parse_iso(sj.status.last_schedule_time
+                         if sj.status else None)
+        since = last if last is not None else \
+            parse_iso(sj.metadata.creation_timestamp) or (now - 60)
+        try:
+            due = sched.next_after(since)
+        except cron.CronParseError:
+            return
+        if due > now:
+            return
+        # most recent missed time wins (skip intermediate misses, as the
+        # reference does when too many are outstanding)
+        latest = due
+        while True:
+            try:
+                nxt = sched.next_after(latest)
+            except cron.CronParseError:
+                break
+            if nxt > now:
+                break
+            latest = nxt
+        deadline = sj.spec.starting_deadline_seconds
+        if deadline is not None and now - latest > deadline:
+            self._record_schedule(sj, latest)  # missed for good
+            return
+
+        policy = sj.spec.concurrency_policy or batch.ALLOW_CONCURRENT
+        if active and policy == batch.FORBID_CONCURRENT:
+            return
+        if active and policy == batch.REPLACE_CONCURRENT:
+            for ref in active:
+                try:
+                    self.client.delete("jobs", ref.name, ns)
+                except ApiError as e:
+                    if not e.is_not_found:
+                        raise
+
+        job = self._job_from_template(sj, latest)
+        try:
+            created = self.client.create("jobs", job, ns)
+        except ApiError as e:
+            if not e.is_conflict:
+                raise
+            created = None  # this scheduled run already fired
+        self._record_schedule(sj, latest, created)
+
+    def _prune_active(self, sj) -> List[api.ObjectReference]:
+        """Drop finished/vanished jobs from status.active; return live ones."""
+        refs = (sj.status.active if sj.status else None) or []
+        live = []
+        for r in refs:
+            job = self.job_informer.store.get(
+                f"{sj.metadata.namespace}/{r.name}")
+            if job is None:
+                continue
+            if any(c.type in (batch.JOB_COMPLETE, batch.JOB_FAILED)
+                   and c.status == api.CONDITION_TRUE
+                   for c in ((job.status.conditions or [])
+                             if job.status else [])):
+                continue
+            live.append(r)
+        if len(live) != len(refs):
+            fresh = deep_copy(sj)
+            if fresh.status is None:
+                fresh.status = batch.ScheduledJobStatus()
+            fresh.status.active = live or None
+            try:
+                self.client.update_status("scheduledjobs", fresh)
+            except ApiError as e:
+                if not (e.is_not_found or e.is_conflict):
+                    raise
+        return live
+
+    def _job_from_template(self, sj, scheduled_epoch: float) -> batch.Job:
+        tpl = sj.spec.job_template or batch.JobTemplateSpec()
+        meta = tpl.metadata or api.ObjectMeta()
+        return batch.Job(
+            metadata=api.ObjectMeta(
+                name=job_name_for(sj, scheduled_epoch),
+                namespace=sj.metadata.namespace,
+                labels=dict(meta.labels or {}),
+                annotations=dict(meta.annotations or {}),
+                owner_references=[api.OwnerReference(
+                    kind="ScheduledJob", name=sj.metadata.name,
+                    uid=sj.metadata.uid, controller=True)]),
+            spec=deep_copy(tpl.spec) if tpl.spec else batch.JobSpec())
+
+    def _record_schedule(self, sj, scheduled_epoch: float,
+                         created_job=None) -> None:
+        # read-modify-write against the LIVE object: _prune_active may have
+        # bumped the resourceVersion this same sync, and silently losing this
+        # write would hide the new job from the concurrency-policy check
+        for _ in range(5):
+            try:
+                fresh = deep_copy(self.client.get(
+                    "scheduledjobs", sj.metadata.name, sj.metadata.namespace))
+            except ApiError as e:
+                if e.is_not_found:
+                    return
+                raise
+            if fresh.status is None:
+                fresh.status = batch.ScheduledJobStatus()
+            fresh.status.last_schedule_time = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(scheduled_epoch))
+            if created_job is not None:
+                refs = [r for r in (fresh.status.active or [])
+                        if r.name != created_job.metadata.name]
+                refs.append(api.ObjectReference(
+                    kind="Job", namespace=created_job.metadata.namespace,
+                    name=created_job.metadata.name,
+                    uid=created_job.metadata.uid))
+                fresh.status.active = refs
+            try:
+                self.client.update_status("scheduledjobs", fresh)
+                return
+            except ApiError as e:
+                if e.is_not_found:
+                    return
+                if not e.is_conflict:
+                    raise
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.sj_informer.run()
+        self.job_informer.run()
+        self.sj_informer.wait_for_sync()
+        self.job_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.sj_informer.stop()
+        self.job_informer.stop()
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"

@@ -1,0 +1,115 @@
+"""Minimal cron schedule parser for the scheduled-job controller.
+
+Parity target: the cron syntax the reference's scheduledjob controller accepts
+via github.com/robfig/cron (5 fields: minute hour day-of-month month
+day-of-week; each a '*', '*/step', value, range 'a-b', or comma list).
+"""
+
+from __future__ import annotations
+
+import calendar
+import time
+from typing import Set, Tuple
+
+_FIELD_RANGES: Tuple[Tuple[int, int], ...] = (
+    (0, 59),   # minute
+    (0, 23),   # hour
+    (1, 31),   # day of month
+    (1, 12),   # month
+    (0, 6),    # day of week (0=Sunday)
+)
+
+
+class CronParseError(ValueError):
+    pass
+
+
+def _parse_field(expr: str, lo: int, hi: int) -> Set[int]:
+    out: Set[int] = set()
+    for part in expr.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise CronParseError(f"bad step {step_s!r}")
+            if step <= 0:
+                raise CronParseError(f"step must be positive: {step}")
+        if part == "*":
+            start, end = lo, hi
+        elif part == "":
+            raise CronParseError("empty field part")
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            try:
+                start, end = int(a), int(b)
+            except ValueError:
+                raise CronParseError(f"bad range {part!r}")
+        else:
+            try:
+                start = end = int(part)
+            except ValueError:
+                raise CronParseError(f"bad value {part!r}")
+        if not (lo <= start <= hi and lo <= end <= hi and start <= end):
+            raise CronParseError(f"value out of range [{lo},{hi}]: {part!r}")
+        out.update(range(start, end + 1, step))
+    return out
+
+
+class Schedule:
+    def __init__(self, spec: str):
+        fields = spec.split()
+        if len(fields) != 5:
+            raise CronParseError(
+                f"expected 5 cron fields, got {len(fields)}: {spec!r}")
+        (self.minutes, self.hours, self.dom, self.months, self.dow) = (
+            _parse_field(f, lo, hi)
+            for f, (lo, hi) in zip(fields, _FIELD_RANGES))
+        # '*' day fields are wildcards: standard cron ORs dom/dow only when
+        # both are restricted
+        self.dom_star = fields[2] == "*"
+        self.dow_star = fields[4] == "*"
+
+    def _day_matches(self, tm: time.struct_time) -> bool:
+        dom_ok = tm.tm_mday in self.dom
+        dow_ok = ((tm.tm_wday + 1) % 7) in self.dow  # struct_time: Mon=0
+        if self.dom_star and self.dow_star:
+            return True
+        if self.dom_star:
+            return dow_ok
+        if self.dow_star:
+            return dom_ok
+        return dom_ok or dow_ok
+
+    def matches(self, epoch: float) -> bool:
+        tm = time.gmtime(epoch)
+        return (tm.tm_min in self.minutes and tm.tm_hour in self.hours
+                and tm.tm_mon in self.months and self._day_matches(tm))
+
+    def next_after(self, epoch: float, horizon_days: int = 366 * 2) -> float:
+        """First matching minute strictly after `epoch` (UTC). Raises if none
+        within the horizon (e.g. Feb 30). Skips by day/hour when those fields
+        don't match, so the scan is cheap even for sparse schedules."""
+        t = (int(epoch) // 60 + 1) * 60  # next minute boundary
+        deadline = t + horizon_days * 86400
+        while t < deadline:
+            tm = time.gmtime(t)
+            if not (tm.tm_mon in self.months and self._day_matches(tm)):
+                t = (int(t) // 86400 + 1) * 86400  # next midnight
+                continue
+            if tm.tm_hour not in self.hours:
+                t = (int(t) // 3600 + 1) * 3600  # next hour
+                continue
+            if tm.tm_min in self.minutes:
+                return float(t)
+            t += 60
+        raise CronParseError("no matching time within horizon")
+
+
+def parse(spec: str) -> Schedule:
+    return Schedule(spec)
+
+
+def timegm(tm) -> float:
+    return float(calendar.timegm(tm))

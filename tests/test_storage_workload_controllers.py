@@ -1,0 +1,283 @@
+"""PersistentVolume binder, PetSet, ScheduledJob controllers + cron parser
+(reference pkg/controller/{persistentvolume,petset,scheduledjob})."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apis import apps, batch
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.controllers.persistentvolume_controller import (
+    CLAIM_BOUND, RECLAIM_DELETE, RECLAIM_RECYCLE, VOLUME_AVAILABLE,
+    VOLUME_BOUND, VOLUME_RELEASED, PersistentVolumeController,
+)
+from kubernetes_tpu.controllers.petset_controller import PetSetController
+from kubernetes_tpu.controllers.scheduledjob_controller import (
+    ScheduledJobController,
+)
+from kubernetes_tpu.utils import cron
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=2000, burst=2000)
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:
+            pass
+        time.sleep(0.03)
+    raise AssertionError("condition not met")
+
+
+def _pv(name, size="10Gi", policy="Retain", modes=("ReadWriteOnce",)):
+    return api.PersistentVolume(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.PersistentVolumeSpec(
+            capacity={"storage": size}, access_modes=list(modes),
+            persistent_volume_reclaim_policy=policy,
+            gce_persistent_disk=api.GCEPersistentDiskVolumeSource(
+                pd_name=name)))
+
+
+def _pvc(name, size="5Gi", modes=("ReadWriteOnce",)):
+    return api.PersistentVolumeClaim(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PersistentVolumeClaimSpec(
+            access_modes=list(modes),
+            resources=api.ResourceRequirements(requests={"storage": size})))
+
+
+class TestCron:
+    def test_every_minute(self):
+        s = cron.parse("* * * * *")
+        t = s.next_after(0)
+        assert t == 60
+
+    def test_specific_time(self):
+        s = cron.parse("30 14 * * *")
+        # 1970-01-01 00:00 -> first match 14:30 same day
+        assert s.next_after(0) == 14 * 3600 + 30 * 60
+
+    def test_step_and_list(self):
+        s = cron.parse("*/15 0 * * *")
+        assert s.next_after(0) == 15 * 60
+        s2 = cron.parse("0,30 * * * *")
+        assert s2.next_after(0) == 30 * 60
+
+    def test_bad_spec(self):
+        with pytest.raises(cron.CronParseError):
+            cron.parse("not a cron")
+        with pytest.raises(cron.CronParseError):
+            cron.parse("61 * * * *")
+
+
+class TestPersistentVolumeController:
+    def test_bind_smallest_fit_and_recycle(self, client):
+        ctrl = PersistentVolumeController(client)
+        ctrl.start()
+        try:
+            client.create("persistentvolumes", _pv("big", "100Gi",
+                                                   RECLAIM_RECYCLE))
+            client.create("persistentvolumes", _pv("small", "10Gi",
+                                                   RECLAIM_RECYCLE))
+            _wait(lambda: client.get("persistentvolumes", "small")
+                  .status.phase == VOLUME_AVAILABLE)
+
+            client.create("persistentvolumeclaims", _pvc("data", "5Gi"),
+                          "default")
+            _wait(lambda: client.get("persistentvolumeclaims", "data",
+                                     "default").status.phase == CLAIM_BOUND)
+            pvc = client.get("persistentvolumeclaims", "data", "default")
+            assert pvc.spec.volume_name == "small"  # smallest fit wins
+            _wait(lambda: client.get("persistentvolumes", "small")
+                  .status.phase == VOLUME_BOUND)
+
+            # deleting the claim recycles the volume back to Available
+            client.delete("persistentvolumeclaims", "data", "default")
+            _wait(lambda: client.get("persistentvolumes", "small")
+                  .status.phase == VOLUME_AVAILABLE)
+            assert client.get("persistentvolumes", "small") \
+                .spec.claim_ref is None
+        finally:
+            ctrl.stop()
+
+    def test_retain_goes_released_and_delete_removes(self, client):
+        ctrl = PersistentVolumeController(client)
+        ctrl.start()
+        try:
+            client.create("persistentvolumes", _pv("keep", "10Gi", "Retain"))
+            client.create("persistentvolumes", _pv("gone", "10Gi",
+                                                   RECLAIM_DELETE))
+            client.create("persistentvolumeclaims", _pvc("a", "5Gi"),
+                          "default")
+            _wait(lambda: client.get("persistentvolumeclaims", "a", "default")
+                  .status.phase == CLAIM_BOUND)
+            bound_to = client.get("persistentvolumeclaims", "a",
+                                  "default").spec.volume_name
+            client.delete("persistentvolumeclaims", "a", "default")
+            if bound_to == "keep":
+                _wait(lambda: client.get("persistentvolumes", "keep")
+                      .status.phase == VOLUME_RELEASED)
+            else:
+                _wait(lambda: not any(
+                    v.metadata.name == "gone"
+                    for v in client.list("persistentvolumes")[0]))
+        finally:
+            ctrl.stop()
+
+    def test_capacity_too_small_stays_pending(self, client):
+        ctrl = PersistentVolumeController(client)
+        ctrl.start()
+        try:
+            client.create("persistentvolumes", _pv("tiny", "1Gi"))
+            client.create("persistentvolumeclaims", _pvc("huge", "500Gi"),
+                          "default")
+            time.sleep(0.5)
+            pvc = client.get("persistentvolumeclaims", "huge", "default")
+            assert (pvc.status.phase if pvc.status else "") != CLAIM_BOUND
+        finally:
+            ctrl.stop()
+
+
+class TestPetSetController:
+    def _petset(self, replicas=3):
+        return apps.PetSet(
+            metadata=api.ObjectMeta(name="db", namespace="default"),
+            spec=apps.PetSetSpec(
+                replicas=replicas, service_name="db",
+                selector=api.LabelSelector(match_labels={"app": "db"}),
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "db"}),
+                    spec=api.PodSpec(containers=[api.Container(
+                        name="db", image="db:1")])),
+                volume_claim_templates=[api.PersistentVolumeClaim(
+                    metadata=api.ObjectMeta(name="data"),
+                    spec=api.PersistentVolumeClaimSpec(
+                        access_modes=["ReadWriteOnce"],
+                        resources=api.ResourceRequirements(
+                            requests={"storage": "1Gi"})))]))
+
+    def _make_ready(self, client, name):
+        p = client.get("pods", name, "default")
+        p.status = api.PodStatus(
+            phase=api.POD_RUNNING,
+            conditions=[api.PodCondition(type=api.POD_READY,
+                                         status=api.CONDITION_TRUE)])
+        client.update_status("pods", p)
+
+    def test_ordinal_sequential_bringup_with_claims(self, client):
+        ctrl = PetSetController(client)
+        ctrl.start()
+        try:
+            client.create("petsets", self._petset(3), "default")
+            # only pet 0 at first (sequential)
+            _wait(lambda: client.get("pods", "db-0", "default"))
+            time.sleep(0.3)
+            pods = client.list("pods", "default", label_selector="app=db")[0]
+            assert [p.metadata.name for p in pods] == ["db-0"]
+            # claim created with the {template}-{pet} name and mounted
+            pvc = client.get("persistentvolumeclaims", "data-db-0", "default")
+            assert pvc.spec.resources.requests["storage"] == "1Gi"
+            p0 = client.get("pods", "db-0", "default")
+            assert p0.spec.volumes[0].persistent_volume_claim.claim_name == \
+                "data-db-0"
+
+            self._make_ready(client, "db-0")
+            _wait(lambda: client.get("pods", "db-1", "default"))
+            self._make_ready(client, "db-1")
+            _wait(lambda: client.get("pods", "db-2", "default"))
+            self._make_ready(client, "db-2")
+            _wait(lambda: client.get("petsets", "db", "default")
+                  .status.replicas == 3)
+        finally:
+            ctrl.stop()
+
+    def test_scale_down_highest_ordinal_first(self, client):
+        ctrl = PetSetController(client)
+        ctrl.start()
+        try:
+            client.create("petsets", self._petset(2), "default")
+            _wait(lambda: client.get("pods", "db-0", "default"))
+            self._make_ready(client, "db-0")
+            _wait(lambda: client.get("pods", "db-1", "default"))
+            self._make_ready(client, "db-1")
+
+            live = client.get("petsets", "db", "default")
+            live.spec.replicas = 1
+            client.update("petsets", live, "default")
+            _wait(lambda: len(client.list("pods", "default",
+                                          label_selector="app=db")[0]) == 1)
+            assert client.get("pods", "db-0", "default")  # 0 survives
+        finally:
+            ctrl.stop()
+
+
+class TestScheduledJobController:
+    def test_fires_due_schedule_and_tracks_active(self, client):
+        fake_now = [time.time()]
+        ctrl = ScheduledJobController(client, sync_seconds=0.2,
+                                      clock=lambda: fake_now[0])
+        ctrl.start()
+        try:
+            sj = batch.ScheduledJob(
+                metadata=api.ObjectMeta(name="tick", namespace="default"),
+                spec=batch.ScheduledJobSpec(
+                    schedule="* * * * *",
+                    job_template=batch.JobTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"sj": "tick"}),
+                        spec=batch.JobSpec(
+                            parallelism=1, completions=1,
+                            selector=api.LabelSelector(
+                                match_labels={"sj": "tick"}),
+                            template=api.PodTemplateSpec(
+                                metadata=api.ObjectMeta(
+                                    labels={"sj": "tick"}),
+                                spec=api.PodSpec(containers=[api.Container(
+                                    name="c", image="task")]))))))
+            client.create("scheduledjobs", sj, "default")
+            # jump the clock past the next minute boundary
+            fake_now[0] = (int(time.time()) // 60 + 2) * 60 + 1
+            _wait(lambda: len(client.list("jobs", "default")[0]) == 1)
+            job = client.list("jobs", "default")[0][0]
+            assert job.metadata.name.startswith("tick-")
+            assert job.metadata.owner_references[0].kind == "ScheduledJob"
+            st = client.get("scheduledjobs", "tick", "default").status
+            assert st.last_schedule_time
+            _wait(lambda: (client.get("scheduledjobs", "tick", "default")
+                           .status.active or []) != [])
+        finally:
+            ctrl.stop()
+
+    def test_suspend_blocks_firing(self, client):
+        fake_now = [time.time()]
+        ctrl = ScheduledJobController(client, sync_seconds=0.2,
+                                      clock=lambda: fake_now[0])
+        ctrl.start()
+        try:
+            sj = batch.ScheduledJob(
+                metadata=api.ObjectMeta(name="halt", namespace="default"),
+                spec=batch.ScheduledJobSpec(
+                    schedule="* * * * *", suspend=True,
+                    job_template=batch.JobTemplateSpec(
+                        spec=batch.JobSpec())))
+            client.create("scheduledjobs", sj, "default")
+            fake_now[0] = (int(time.time()) // 60 + 2) * 60 + 1
+            time.sleep(0.8)
+            assert client.list("jobs", "default")[0] == []
+        finally:
+            ctrl.stop()
