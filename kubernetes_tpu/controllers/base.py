@@ -9,7 +9,8 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, List, Optional
+import time
+from typing import Callable, Dict, List, Optional
 
 from kubernetes_tpu.utils.workqueue import RateLimitingQueue
 
@@ -26,12 +27,30 @@ class Controller:
         self.workers = workers
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        self._armed: Dict[str, float] = {}
+        self._armed_lock = threading.Lock()
 
     def enqueue(self, key: str):
         self.queue.add(key)
 
     def enqueue_after(self, key: str, delay: float):
         self.queue.add_after(key, delay)
+
+    def arm_resync(self, key: str, delay: float):
+        """Schedule a delayed re-sync, at most ONE outstanding per key.
+        Event-driven syncs calling this repeatedly must not each spawn a new
+        delayed entry — the DelayingQueue heap doesn't dedup future entries,
+        so unconditional re-arming grows without bound."""
+        now = time.monotonic()
+        with self._armed_lock:
+            if self._armed.get(key, 0.0) > now:
+                return  # a timer is already pending for this key
+            self._armed[key] = now + delay
+        self.queue.add_after(key, delay)
+
+    def disarm_resync(self, key: str):
+        with self._armed_lock:
+            self._armed.pop(key, None)
 
     def sync(self, key: str) -> None:
         raise NotImplementedError

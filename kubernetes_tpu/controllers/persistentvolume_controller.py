@@ -88,10 +88,18 @@ class PersistentVolumeController(Controller):
                 self.enqueue(f"pvc|{_nn(c)}")
 
     def _claim_deleted(self, pvc):
-        # release the volume this claim was bound to
+        # release the volume this claim was bound to; ALSO sweep volumes
+        # whose claimRef names this claim — a bind interrupted between the
+        # PV and PVC writes leaves the volume pointing at a claim that never
+        # recorded volume_name
         vol_name = pvc.spec.volume_name if pvc.spec else ""
         if vol_name:
             self.enqueue(f"pv|{vol_name}")
+        ns, name = pvc.metadata.namespace, pvc.metadata.name
+        for pv in self.pv_informer.store.list():
+            ref = pv.spec.claim_ref if pv.spec else None
+            if ref is not None and ref.namespace == ns and ref.name == name:
+                self.enqueue(f"pv|{pv.metadata.name}")
 
     # --- reconcile -----------------------------------------------------------
 

@@ -82,11 +82,12 @@ class HorizontalController(Controller):
     def sync(self, key: str) -> None:
         hpa = self.hpa_informer.store.get(key)
         if hpa is None or hpa.spec is None:
+            self.disarm_resync(key)
             return
         try:
             self._reconcile(hpa)
         finally:
-            self.enqueue_after(key, self.sync_seconds)  # periodic resync
+            self.arm_resync(key, self.sync_seconds)  # periodic resync
 
     def _reconcile(self, hpa: autoscaling.HorizontalPodAutoscaler) -> None:
         ref = hpa.spec.scale_target_ref

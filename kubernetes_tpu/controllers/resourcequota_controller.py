@@ -78,13 +78,14 @@ class ResourceQuotaController(Controller):
     def sync(self, key: str) -> None:
         quota = self.quota_informer.store.get(key)
         if quota is None:
+            self.disarm_resync(key)
             return
         hard = (quota.spec.hard if quota.spec else None) or {}
         used = self._calculate_usage(quota.metadata.namespace, hard)
         used_str = {k: format_usage(k, v) for k, v in used.items()}
         st = quota.status
         if st and st.hard == hard and st.used == used_str:
-            self.enqueue_after(key, self.resync_seconds)
+            self.arm_resync(key, self.resync_seconds)
             return
         fresh = deep_copy(quota)
         fresh.status = api.ResourceQuotaStatus(hard=dict(hard), used=used_str)
@@ -93,7 +94,7 @@ class ResourceQuotaController(Controller):
         except ApiError as e:
             if not (e.is_not_found or e.is_conflict):
                 raise
-        self.enqueue_after(key, self.resync_seconds)
+        self.arm_resync(key, self.resync_seconds)
 
     # --- lifecycle -----------------------------------------------------------
 

@@ -61,11 +61,12 @@ class ScheduledJobController(Controller):
     def sync(self, key: str) -> None:
         sj = self.sj_informer.store.get(key)
         if sj is None:
+            self.disarm_resync(key)
             return
         try:
             self._reconcile(sj)
         finally:
-            self.enqueue_after(key, self.sync_seconds)
+            self.arm_resync(key, self.sync_seconds)
 
     def _reconcile(self, sj: batch.ScheduledJob) -> None:
         ns = sj.metadata.namespace
@@ -133,7 +134,16 @@ class ScheduledJobController(Controller):
             job = self.job_informer.store.get(
                 f"{sj.metadata.namespace}/{r.name}")
             if job is None:
-                continue
+                # informer may simply lag behind our own create — confirm
+                # with the API before declaring the job gone, or Forbid
+                # concurrency would launch an overlapping run
+                try:
+                    job = self.client.get("jobs", r.name,
+                                          sj.metadata.namespace)
+                except ApiError as e:
+                    if not e.is_not_found:
+                        raise
+                    continue
             if any(c.type in (batch.JOB_COMPLETE, batch.JOB_FAILED)
                    and c.status == api.CONDITION_TRUE
                    for c in ((job.status.conditions or [])

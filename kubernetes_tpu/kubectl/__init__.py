@@ -1,0 +1,9 @@
+"""kubectl: the L9 CLI (reference pkg/kubectl + cmd/kubectl).
+
+Run as `python -m kubernetes_tpu.kubectl <command> ...` against an apiserver
+(--server host:port). Subcommands mirror the reference cobra tree
+(pkg/kubectl/cmd/): get, describe, create, apply, delete, scale, rollout,
+label, annotate, cordon/uncordon/drain, run, expose, autoscale, version,
+api-versions, cluster-info."""
+
+from kubernetes_tpu.kubectl.cmd import main  # noqa: F401

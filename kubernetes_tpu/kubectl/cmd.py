@@ -1,0 +1,632 @@
+"""kubectl subcommands.
+
+Parity target: reference pkg/kubectl/cmd/*.go — one function per cobra
+command, argparse instead of cobra. Command inventory covered: get, describe,
+create, apply, delete, scale, rollout {status,history,undo,pause,resume},
+label, annotate, cordon, uncordon, drain, run, expose, autoscale, version,
+api-versions, cluster-info."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.apis import autoscaling, extensions as ext
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.kubectl import printers, resource as res
+from kubernetes_tpu.registry.generic import RESOURCES
+from kubernetes_tpu.utils import strategicpatch
+
+ANN_LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+VERSION = "v1.3.0-tpu"
+
+
+class CommandError(Exception):
+    pass
+
+
+def _client(args) -> RESTClient:
+    host, _, port = (args.server or "127.0.0.1:8080").partition(":")
+    return RESTClient(host=host, port=int(port or 8080),
+                      user_agent="kubectl",
+                      bearer_token=getattr(args, "token", None) or "")
+
+
+def _ns(args) -> str:
+    return getattr(args, "namespace", None) or "default"
+
+
+def _is_namespaced(resource: str) -> bool:
+    rd = RESOURCES.get(resource)
+    return rd.namespaced if rd else True
+
+
+def _get_objs(client, args, pairs, all_namespaces=False):
+    out = []
+    for resource, name in pairs:
+        ns = "" if (all_namespaces or not _is_namespaced(resource)) \
+            else _ns(args)
+        if name:
+            out.append((resource, [client.get(resource, name, ns)]))
+        else:
+            items, _ = client.list(
+                resource, ns,
+                label_selector=getattr(args, "selector", None))
+            out.append((resource, items))
+    return out
+
+
+# --- get / describe ----------------------------------------------------------
+
+def cmd_get(args) -> int:
+    client = _client(args)
+    pairs = res.parse_args(args.args)
+    blocks = _get_objs(client, args, pairs,
+                       all_namespaces=args.all_namespaces)
+    outputs = []
+    for resource, objs in blocks:
+        if not objs and len(blocks) == 1 and args.output in (None, "", "wide"):
+            ns_msg = "" if args.all_namespaces else f" in {_ns(args)} namespace"
+            print(f"No resources found{ns_msg}.", file=sys.stderr)
+            return 0
+        outputs.append(printers.print_objs(
+            resource, objs, args.output, wide=(args.output == "wide"),
+            show_namespace=args.all_namespaces))
+    print("\n\n".join(o for o in outputs if o))
+    return 0
+
+
+def _describe_lines(resource: str, obj) -> List[str]:
+    """Key: value dump (reference pkg/kubectl/describe.go per-kind
+    describers, generalized)."""
+    m = obj.metadata or api.ObjectMeta()
+    lines = [f"Name:\t{m.name}"]
+    if _is_namespaced(resource):
+        lines.append(f"Namespace:\t{m.namespace}")
+    lines.append("Labels:\t" + (",".join(
+        f"{k}={v}" for k, v in sorted((m.labels or {}).items())) or "<none>"))
+    lines.append("Annotations:\t" + (",".join(
+        sorted(k for k in (m.annotations or {}))) or "<none>"))
+    if resource == "pods":
+        spec = obj.spec or api.PodSpec()
+        st = obj.status or api.PodStatus()
+        lines.append(f"Node:\t{spec.node_name or '<none>'}")
+        lines.append(f"Status:\t{st.phase or 'Unknown'}")
+        lines.append("Containers:")
+        for c in spec.containers or []:
+            lines.append(f"  {c.name}:")
+            lines.append(f"    Image:\t{c.image}")
+            req = (c.resources.requests if c.resources else None) or {}
+            if req:
+                lines.append("    Requests:")
+                for k, v in sorted(req.items()):
+                    lines.append(f"      {k}:\t{v}")
+        conds = st.conditions or []
+        if conds:
+            lines.append("Conditions:")
+            lines.append("  Type\tStatus")
+            for c in conds:
+                lines.append(f"  {c.type}\t{c.status}")
+    elif resource == "nodes":
+        st = obj.status or api.NodeStatus()
+        lines.append("Conditions:")
+        for c in st.conditions or []:
+            lines.append(f"  {c.type}\t{c.status}")
+        alloc = st.allocatable or {}
+        if alloc:
+            lines.append("Allocatable:")
+            for k, v in sorted(alloc.items()):
+                lines.append(f"  {k}:\t{v}")
+        if obj.spec and obj.spec.unschedulable:
+            lines.append("Unschedulable:\ttrue")
+        if obj.spec and obj.spec.taints:
+            lines.append("Taints:\t" + ",".join(
+                f"{t.key}={t.value}:{t.effect}" for t in obj.spec.taints))
+    elif resource == "services":
+        spec = obj.spec or api.ServiceSpec()
+        lines.append(f"Selector:\t" + (",".join(
+            f"{k}={v}" for k, v in sorted((spec.selector or {}).items()))
+            or "<none>"))
+        lines.append(f"IP:\t{spec.cluster_ip or '<none>'}")
+        for p in spec.ports or []:
+            lines.append(f"Port:\t{p.name or '<unset>'}\t"
+                         f"{p.port}/{p.protocol or 'TCP'}")
+    elif resource in ("replicationcontrollers", "replicasets",
+                      "deployments", "petsets"):
+        spec = obj.spec
+        st = obj.status
+        lines.append(f"Replicas:\t{(st.replicas if st else 0)} current / "
+                     f"{(spec.replicas or 0) if spec else 0} desired")
+    return lines
+
+
+def cmd_describe(args) -> int:
+    client = _client(args)
+    pairs = res.parse_args(args.args)
+    blocks = _get_objs(client, args, pairs)
+    chunks = []
+    for resource, objs in blocks:
+        for o in objs:
+            chunks.append("\n".join(_describe_lines(resource, o)))
+    print("\n\n\n".join(chunks))
+    return 0
+
+
+# --- create / apply / delete --------------------------------------------------
+
+def cmd_create(args) -> int:
+    client = _client(args)
+    if not args.filename:
+        raise CommandError("must specify -f")
+    for resource, obj, _raw in res.load_files(args.filename):
+        ns = (obj.metadata.namespace if obj.metadata else "") or _ns(args)
+        created = client.create(resource, obj,
+                                ns if _is_namespaced(resource) else "")
+        print(f"{RESOURCES[resource].kind.lower()} "
+              f"\"{created.metadata.name}\" created")
+    return 0
+
+
+def cmd_apply(args) -> int:
+    """Three-way strategic merge against the last-applied annotation
+    (reference pkg/kubectl/cmd/apply.go)."""
+    client = _client(args)
+    if not args.filename:
+        raise CommandError("must specify -f")
+    for resource, obj, raw in res.load_files(args.filename):
+        ns = (obj.metadata.namespace if obj.metadata else "") or _ns(args)
+        if not _is_namespaced(resource):
+            ns = ""
+        name = obj.metadata.name if obj.metadata else ""
+        modified = json.dumps(raw, sort_keys=True)
+        try:
+            live = client.get(resource, name, ns)
+        except ApiError as e:
+            if not e.is_not_found:
+                raise
+            if obj.metadata.annotations is None:
+                obj.metadata.annotations = {}
+            obj.metadata.annotations[ANN_LAST_APPLIED] = modified
+            client.create(resource, obj, ns)
+            print(f"{RESOURCES[resource].kind.lower()} \"{name}\" created")
+            continue
+        live_dict = scheme.encode(live)
+        original = json.loads(
+            (live.metadata.annotations or {}).get(ANN_LAST_APPLIED, "{}"))
+        merged = strategicpatch.three_way_merge(original, raw, live_dict)
+        md = merged.setdefault("metadata", {})
+        md.setdefault("annotations", {})
+        if md["annotations"] is None:
+            md["annotations"] = {}
+        md["annotations"][ANN_LAST_APPLIED] = modified
+        # carry the live resourceVersion for optimistic concurrency
+        md["resourceVersion"] = live.metadata.resource_version
+        merged_obj = scheme.decode_into(RESOURCES[resource].cls, merged)
+        client.update(resource, merged_obj, ns)
+        print(f"{RESOURCES[resource].kind.lower()} \"{name}\" configured")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    client = _client(args)
+    if args.filename:
+        # honor each manifest's own namespace, same as create
+        pairs = [(r, o.metadata.name,
+                  (o.metadata.namespace if o.metadata else "") or _ns(args))
+                 for r, o, _ in res.load_files(args.filename)]
+    else:
+        pairs = [(r, n, _ns(args)) for r, n in res.parse_args(args.args)]
+    for resource, name, ns in pairs:
+        if not _is_namespaced(resource):
+            ns = ""
+        if name is None:
+            if not args.all and not args.selector:
+                raise CommandError(
+                    "resource(s) were provided, but no name, label "
+                    "selector, or --all flag specified")
+            items, _ = client.list(resource, ns,
+                                   label_selector=args.selector)
+            names = [o.metadata.name for o in items]
+        else:
+            names = [name]
+        for n in names:
+            try:
+                client.delete(resource, n, ns)
+                print(f"{RESOURCES[resource].kind.lower()} \"{n}\" deleted")
+            except ApiError as e:
+                if not (e.is_not_found and args.ignore_not_found):
+                    raise
+    return 0
+
+
+# --- scale / rollout / autoscale ---------------------------------------------
+
+def cmd_scale(args) -> int:
+    client = _client(args)
+    pairs = res.parse_args(args.args)
+    for resource, name in pairs:
+        if name is None:
+            raise CommandError("name is required for scale")
+        sc = client.get_scale(resource, name, _ns(args))
+        sc.spec.replicas = args.replicas
+        client.update_scale(resource, name, _ns(args), sc)
+        print(f"{RESOURCES[resource].kind.lower()} \"{name}\" scaled")
+    return 0
+
+
+def cmd_rollout(args) -> int:
+    client = _client(args)
+    sub = args.subcommand
+    pairs = res.parse_args(args.args)
+    for resource, name in pairs:
+        if resource != "deployments":
+            raise CommandError(f"rollout is not supported on {resource}")
+        ns = _ns(args)
+        if sub == "status":
+            deadline = time.time() + args.timeout
+            while True:
+                d = client.get(resource, name, ns)
+                want = (d.spec.replicas or 0) if d.spec else 0
+                st = d.status or ext.DeploymentStatus()
+                if st.updated_replicas >= want and \
+                        st.available_replicas >= want:
+                    print(f"deployment \"{name}\" successfully rolled out")
+                    break
+                if time.time() > deadline:
+                    raise CommandError(
+                        f"deployment \"{name}\" not rolled out: "
+                        f"{st.updated_replicas} updated, "
+                        f"{st.available_replicas} available, {want} desired")
+                time.sleep(0.2)
+        elif sub == "history":
+            items, _ = client.list("replicasets", ns)
+            revs = []
+            for rs in items:
+                refs = (rs.metadata.owner_references or [])
+                if any(r.kind == "Deployment" and r.name == name
+                       for r in refs):
+                    revs.append(int((rs.metadata.annotations or {}).get(
+                        ext.ANN_REVISION, "0")))
+            print(f"deployments \"{name}\"")
+            print("REVISION")
+            for rv in sorted(revs):
+                print(rv)
+        elif sub == "undo":
+            client.rollback_deployment(name, ns, ext.DeploymentRollback(
+                name=name,
+                rollback_to=ext.RollbackConfig(revision=args.to_revision)))
+            print(f"deployment \"{name}\" rolled back")
+        elif sub in ("pause", "resume"):
+            d = client.get(resource, name, ns)
+            d.spec.paused = (sub == "pause")
+            client.update(resource, d, ns)
+            print(f"deployment \"{name}\" {sub}d")
+        else:
+            raise CommandError(f"unknown rollout subcommand {sub!r}")
+    return 0
+
+
+def cmd_autoscale(args) -> int:
+    client = _client(args)
+    pairs = res.parse_args(args.args)
+    for resource, name in pairs:
+        kind = RESOURCES[resource].kind
+        hpa = autoscaling.HorizontalPodAutoscaler(
+            metadata=api.ObjectMeta(name=args.name or name,
+                                    namespace=_ns(args)),
+            spec=autoscaling.HorizontalPodAutoscalerSpec(
+                scale_target_ref=autoscaling.CrossVersionObjectReference(
+                    kind=kind, name=name),
+                min_replicas=args.min, max_replicas=args.max,
+                target_cpu_utilization_percentage=args.cpu_percent))
+        client.create("horizontalpodautoscalers", hpa, _ns(args))
+        print(f"{kind.lower()} \"{name}\" autoscaled")
+    return 0
+
+
+# --- label / annotate ---------------------------------------------------------
+
+def _parse_kv_args(kvs: List[str]):
+    sets, removes = {}, []
+    for kv in kvs:
+        if kv.endswith("-") and "=" not in kv:
+            removes.append(kv[:-1])
+        elif "=" in kv:
+            k, v = kv.split("=", 1)
+            sets[k] = v
+        else:
+            raise CommandError(f"invalid KEY=VAL pair: {kv!r}")
+    return sets, removes
+
+
+def _mutate_map(client, args, which: str) -> int:
+    pairs = res.parse_args(args.args)  # _post_parse already removed KEY=VALs
+    sets, removes = _parse_kv_args(args.pairs)
+    for resource, name in pairs:
+        if name is None:
+            raise CommandError("name required")
+        ns = _ns(args) if _is_namespaced(resource) else ""
+        obj = client.get(resource, name, ns)
+        cur = dict(getattr(obj.metadata, which) or {})
+        for k in sets:
+            if k in cur and not args.overwrite and cur[k] != sets[k]:
+                raise CommandError(
+                    f"'{k}' already has a value ({cur[k]}), and "
+                    f"--overwrite is false")
+        cur.update(sets)
+        for k in removes:
+            cur.pop(k, None)
+        setattr(obj.metadata, which, cur or None)
+        client.update(resource, obj, ns)
+        print(f"{RESOURCES[resource].kind.lower()} \"{name}\" labeled"
+              if which == "labels" else
+              f"{RESOURCES[resource].kind.lower()} \"{name}\" annotated")
+    return 0
+
+
+def cmd_label(args) -> int:
+    return _mutate_map(_client(args), args, "labels")
+
+
+def cmd_annotate(args) -> int:
+    return _mutate_map(_client(args), args, "annotations")
+
+
+# --- node ops: cordon / uncordon / drain --------------------------------------
+
+def _set_unschedulable(client, name: str, value: bool) -> None:
+    node = client.get("nodes", name)
+    if node.spec is None:
+        node.spec = api.NodeSpec()
+    node.spec.unschedulable = value
+    client.update("nodes", node)
+
+
+def cmd_cordon(args) -> int:
+    client = _client(args)
+    for name in args.args:
+        _set_unschedulable(client, name, True)
+        print(f"node \"{name}\" cordoned")
+    return 0
+
+
+def cmd_uncordon(args) -> int:
+    client = _client(args)
+    for name in args.args:
+        _set_unschedulable(client, name, False)
+        print(f"node \"{name}\" uncordoned")
+    return 0
+
+
+def cmd_drain(args) -> int:
+    """Cordon + evict pods (reference pkg/kubectl/cmd/drain.go: refuses
+    unmanaged/daemon pods unless forced)."""
+    client = _client(args)
+    for name in args.args:
+        _set_unschedulable(client, name, True)
+        pods, _ = client.list("pods",
+                              field_selector=f"spec.nodeName={name}")
+        for p in pods:
+            managed = bool((p.metadata.owner_references or [])
+                           or api.ANN_CREATED_BY in
+                           (p.metadata.annotations or {}))
+            daemon = any(r.kind == "DaemonSet"
+                         for r in (p.metadata.owner_references or []))
+            if daemon and not args.ignore_daemonsets:
+                raise CommandError(
+                    f"pod {p.metadata.name} is managed by a DaemonSet; "
+                    "use --ignore-daemonsets")
+            if not managed and not args.force:
+                raise CommandError(
+                    f"pod {p.metadata.name} is not managed by a "
+                    "controller; use --force to delete it")
+            if daemon:
+                continue  # daemon pods are left (their controller pins them)
+            client.delete("pods", p.metadata.name, p.metadata.namespace)
+            print(f"pod \"{p.metadata.name}\" evicted")
+        print(f"node \"{name}\" drained")
+    return 0
+
+
+# --- run / expose -------------------------------------------------------------
+
+def cmd_run(args) -> int:
+    """kubectl run NAME --image=... (reference run.go: generates an RC in
+    this era; --restart=Never generates a bare pod)."""
+    client = _client(args)
+    name = args.name
+    labels = {"run": name}
+    container = api.Container(name=name, image=args.image)
+    if args.restart == "Never":
+        pod = api.Pod(metadata=api.ObjectMeta(name=name, namespace=_ns(args),
+                                              labels=labels),
+                      spec=api.PodSpec(containers=[container]))
+        client.create("pods", pod, _ns(args))
+        print(f"pod \"{name}\" created")
+    else:
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name=name, namespace=_ns(args),
+                                    labels=labels),
+            spec=api.ReplicationControllerSpec(
+                replicas=args.replicas, selector=dict(labels),
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels=dict(labels)),
+                    spec=api.PodSpec(containers=[container]))))
+        client.create("replicationcontrollers", rc, _ns(args))
+        print(f"replicationcontroller \"{name}\" created")
+    return 0
+
+
+def cmd_expose(args) -> int:
+    """Create a service fronting an RC/RS/deployment/service's selector
+    (reference expose.go)."""
+    client = _client(args)
+    pairs = res.parse_args(args.args)
+    for resource, name in pairs:
+        obj = client.get(resource, name, _ns(args))
+        sel = obj.spec.selector if obj.spec else None
+        if isinstance(sel, api.LabelSelector):
+            sel = sel.match_labels
+        if not sel:
+            raise CommandError(f"couldn't find a selector on {resource}/{name}")
+        svc = api.Service(
+            metadata=api.ObjectMeta(name=args.name or name,
+                                    namespace=_ns(args)),
+            spec=api.ServiceSpec(
+                selector=dict(sel),
+                ports=[api.ServicePort(
+                    port=args.port,
+                    target_port=args.target_port or args.port)]))
+        client.create("services", svc, _ns(args))
+        print(f"service \"{svc.metadata.name}\" exposed")
+    return 0
+
+
+# --- misc ---------------------------------------------------------------------
+
+def cmd_version(args) -> int:
+    print(f"Client Version: {VERSION}")
+    try:
+        _client(args).request("GET", "/healthz")
+        print(f"Server Version: {VERSION}")
+    except Exception:
+        pass
+    return 0
+
+
+def cmd_api_versions(args) -> int:
+    groups = sorted({rd.api_version for rd in RESOURCES.values()})
+    for g in groups:
+        print(g)
+    return 0
+
+
+def cmd_cluster_info(args) -> int:
+    print(f"Kubernetes master is running at http://{args.server or '127.0.0.1:8080'}")
+    return 0
+
+
+# --- argparse wiring ----------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubectl", description="kubectl controls the cluster")
+    p.add_argument("-s", "--server", default=None)
+    p.add_argument("--token", default=None)
+    p.add_argument("-n", "--namespace", default=None)
+    sub = p.add_subparsers(dest="command")
+
+    def add(name, fn, **kw):
+        sp = sub.add_parser(name, **kw)
+        sp.set_defaults(fn=fn)
+        return sp
+
+    g = add("get", cmd_get)
+    g.add_argument("args", nargs="+")
+    g.add_argument("-o", "--output", default=None)
+    g.add_argument("-l", "--selector", default=None)
+    g.add_argument("--all-namespaces", action="store_true")
+
+    d = add("describe", cmd_describe)
+    d.add_argument("args", nargs="+")
+    d.add_argument("-l", "--selector", default=None)
+
+    c = add("create", cmd_create)
+    c.add_argument("-f", "--filename", action="append", default=[])
+
+    a = add("apply", cmd_apply)
+    a.add_argument("-f", "--filename", action="append", default=[])
+
+    de = add("delete", cmd_delete)
+    de.add_argument("args", nargs="*", default=[])
+    de.add_argument("-f", "--filename", action="append", default=[])
+    de.add_argument("-l", "--selector", default=None)
+    de.add_argument("--all", action="store_true")
+    de.add_argument("--ignore-not-found", action="store_true")
+
+    sc = add("scale", cmd_scale)
+    sc.add_argument("args", nargs="+")
+    sc.add_argument("--replicas", type=int, required=True)
+
+    ro = add("rollout", cmd_rollout)
+    ro.add_argument("subcommand",
+                    choices=["status", "history", "undo", "pause", "resume"])
+    ro.add_argument("args", nargs="+")
+    ro.add_argument("--to-revision", type=int, default=0)
+    ro.add_argument("--timeout", type=float, default=30.0)
+
+    au = add("autoscale", cmd_autoscale)
+    au.add_argument("args", nargs="+")
+    au.add_argument("--min", type=int, default=1)
+    au.add_argument("--max", type=int, required=True)
+    au.add_argument("--cpu-percent", type=int, default=80)
+    au.add_argument("--name", default=None)
+
+    la = add("label", cmd_label)
+    la.add_argument("args", nargs="+")
+    la.add_argument("--overwrite", action="store_true")
+
+    an = add("annotate", cmd_annotate)
+    an.add_argument("args", nargs="+")
+    an.add_argument("--overwrite", action="store_true")
+
+    co = add("cordon", cmd_cordon)
+    co.add_argument("args", nargs="+")
+    un = add("uncordon", cmd_uncordon)
+    un.add_argument("args", nargs="+")
+    dr = add("drain", cmd_drain)
+    dr.add_argument("args", nargs="+")
+    dr.add_argument("--force", action="store_true")
+    dr.add_argument("--ignore-daemonsets", action="store_true")
+
+    ru = add("run", cmd_run)
+    ru.add_argument("name")
+    ru.add_argument("--image", required=True)
+    ru.add_argument("--replicas", type=int, default=1)
+    ru.add_argument("--restart", default="Always",
+                    choices=["Always", "Never", "OnFailure"])
+
+    ex = add("expose", cmd_expose)
+    ex.add_argument("args", nargs="+")
+    ex.add_argument("--port", type=int, required=True)
+    ex.add_argument("--target-port", type=int, default=None)
+    ex.add_argument("--name", default=None)
+
+    add("version", cmd_version)
+    add("api-versions", cmd_api_versions)
+    add("cluster-info", cmd_cluster_info)
+    return p
+
+
+def _post_parse(args):
+    """label/annotate mix TYPE NAME and KEY=VAL positionals; split them."""
+    if args.command in ("label", "annotate"):
+        rest, pairs = [], []
+        for a in args.args:
+            (pairs if ("=" in a or a.endswith("-")) else rest).append(a)
+        args.args, args.pairs = rest, pairs
+    return args
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        return 1
+    _post_parse(args)
+    try:
+        return args.fn(args)
+    except (CommandError, res.ResourceError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except ApiError as e:
+        print(f"Error from server: {e}", file=sys.stderr)
+        return 1
+

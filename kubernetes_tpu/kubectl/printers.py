@@ -1,0 +1,181 @@
+"""Human-readable and machine output for kubectl.
+
+Parity target: reference pkg/kubectl/resource_printer.go — per-kind table
+columns (HumanReadablePrinter handlers) plus -o json|yaml|name|wide|jsonpath.
+AGE math mirrors translateTimestamp/shortHumanDuration."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+import yaml
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.utils import jsonpath
+from kubernetes_tpu.utils.timeutil import parse_iso
+
+
+def human_duration(seconds: float) -> str:
+    s = int(seconds)
+    if s < 0:
+        s = 0
+    if s < 120:
+        return f"{s}s"
+    m = s // 60
+    if m < 120:
+        return f"{m}m"
+    h = m // 60
+    if h < 48:
+        return f"{h}h"
+    return f"{h // 24}d"
+
+
+def age_of(obj) -> str:
+    ts = parse_iso(obj.metadata.creation_timestamp if obj.metadata else None)
+    if ts is None:
+        return "<unknown>"
+    return human_duration(time.time() - ts)
+
+
+# --- per-kind rows -----------------------------------------------------------
+
+def _pod_row(p: api.Pod, wide: bool) -> List[str]:
+    statuses = (p.status.container_statuses or []) if p.status else []
+    total = len((p.spec.containers or []) if p.spec else [])
+    ready = sum(1 for cs in statuses if cs.ready)
+    restarts = sum(cs.restart_count or 0 for cs in statuses)
+    phase = (p.status.phase if p.status else "") or "Unknown"
+    if p.metadata.deletion_timestamp:
+        phase = "Terminating"
+    row = [_name(p), f"{ready}/{total}", phase, str(restarts), age_of(p)]
+    if wide:
+        row.append((p.spec.node_name if p.spec else "") or "<none>")
+    return row
+
+
+def _node_row(n: api.Node, wide: bool) -> List[str]:
+    ready = "Unknown"
+    for c in ((n.status.conditions or []) if n.status else []):
+        if c.type == api.NODE_READY:
+            ready = "Ready" if c.status == api.CONDITION_TRUE else "NotReady"
+    if n.spec and n.spec.unschedulable:
+        ready += ",SchedulingDisabled"
+    return [_name(n), ready, age_of(n)]
+
+
+def _svc_row(s: api.Service, wide: bool) -> List[str]:
+    spec = s.spec or api.ServiceSpec()
+    ports = ",".join(f"{p.port}/{p.protocol or 'TCP'}"
+                     for p in (spec.ports or []))
+    return [_name(s), spec.cluster_ip or "<none>", ports or "<none>",
+            age_of(s)]
+
+
+def _rc_like_row(o, wide: bool) -> List[str]:
+    desired = (o.spec.replicas or 0) if o.spec else 0
+    current = (o.status.replicas or 0) if o.status else 0
+    return [_name(o), str(desired), str(current), age_of(o)]
+
+
+def _deploy_row(d, wide: bool) -> List[str]:
+    desired = (d.spec.replicas or 0) if d.spec else 0
+    st = d.status
+    return [_name(d), str(desired), str(st.replicas if st else 0),
+            str(st.updated_replicas if st else 0),
+            str(st.available_replicas if st else 0), age_of(d)]
+
+
+def _job_row(j, wide: bool) -> List[str]:
+    desired = (j.spec.completions if j.spec else None)
+    succ = j.status.succeeded if j.status else 0
+    return [_name(j), str(desired if desired is not None else "<none>"),
+            str(succ), age_of(j)]
+
+
+def _ns_row(n, wide: bool) -> List[str]:
+    phase = (n.status.phase if n.status else "") or "Active"
+    return [_name(n), phase, age_of(n)]
+
+
+def _event_row(e, wide: bool) -> List[str]:
+    io = e.involved_object
+    return [e.last_timestamp or "", e.type or "", e.reason or "",
+            f"{io.kind}/{io.name}" if io else "", (e.message or "")[:60]]
+
+
+def _generic_row(o, wide: bool) -> List[str]:
+    return [_name(o), age_of(o)]
+
+
+_HANDLERS = {
+    "pods": (["NAME", "READY", "STATUS", "RESTARTS", "AGE"],
+             ["NODE"], _pod_row),
+    "nodes": (["NAME", "STATUS", "AGE"], [], _node_row),
+    "services": (["NAME", "CLUSTER-IP", "PORT(S)", "AGE"], [], _svc_row),
+    "replicationcontrollers": (["NAME", "DESIRED", "CURRENT", "AGE"], [],
+                               _rc_like_row),
+    "replicasets": (["NAME", "DESIRED", "CURRENT", "AGE"], [], _rc_like_row),
+    "petsets": (["NAME", "DESIRED", "CURRENT", "AGE"], [], _rc_like_row),
+    "deployments": (["NAME", "DESIRED", "CURRENT", "UP-TO-DATE",
+                     "AVAILABLE", "AGE"], [], _deploy_row),
+    "jobs": (["NAME", "DESIRED", "SUCCESSFUL", "AGE"], [], _job_row),
+    "namespaces": (["NAME", "STATUS", "AGE"], [], _ns_row),
+    "events": (["LASTSEEN", "TYPE", "REASON", "OBJECT", "MESSAGE"], [],
+               _event_row),
+}
+
+
+def _name(o) -> str:
+    return o.metadata.name if o.metadata else ""
+
+
+def print_table(resource: str, objs: List, wide: bool = False,
+                show_namespace: bool = False) -> str:
+    headers, wide_headers, row_fn = _HANDLERS.get(
+        resource, (["NAME", "AGE"], [], _generic_row))
+    headers = list(headers) + (list(wide_headers) if wide else [])
+    if show_namespace:
+        headers = ["NAMESPACE"] + headers
+    rows = []
+    for o in objs:
+        r = row_fn(o, wide)
+        if show_namespace:
+            r = [(o.metadata.namespace if o.metadata else "")] + r
+        rows.append(r)
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    lines = ["   ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip()]
+    for r in rows:
+        lines.append("   ".join(c.ljust(w)
+                                for c, w in zip(r, widths)).rstrip())
+    return "\n".join(lines)
+
+
+def print_objs(resource: str, objs: List, output: Optional[str],
+               wide: bool = False, show_namespace: bool = False) -> str:
+    """Dispatch on -o. `objs` is a list; single-item get prints the bare
+    object for json/yaml like the reference."""
+    if output in (None, "", "wide"):
+        return print_table(resource, objs, wide=(output == "wide"),
+                           show_namespace=show_namespace)
+    if output == "name":
+        return "\n".join(f"{_singular(resource)}/{_name(o)}" for o in objs)
+    data = [scheme.encode(o) for o in objs]
+    payload = data[0] if len(data) == 1 else {
+        "kind": "List", "apiVersion": "v1", "items": data}
+    if output == "json":
+        return json.dumps(payload, indent=2)
+    if output == "yaml":
+        return yaml.safe_dump(payload, default_flow_style=False)
+    if output.startswith("jsonpath="):
+        tpl = output[len("jsonpath="):]
+        return "\n".join(jsonpath.evaluate(tpl, scheme.encode(o))
+                         for o in objs)
+    raise ValueError(f"unknown output format {output!r}")
+
+
+def _singular(resource: str) -> str:
+    return resource[:-1] if resource.endswith("s") else resource

@@ -66,20 +66,19 @@ class Schedule:
         (self.minutes, self.hours, self.dom, self.months, self.dow) = (
             _parse_field(f, lo, hi)
             for f, (lo, hi) in zip(fields, _FIELD_RANGES))
-        # '*' day fields are wildcards: standard cron ORs dom/dow only when
-        # both are restricted
-        self.dom_star = fields[2] == "*"
-        self.dow_star = fields[4] == "*"
+        # day fields beginning with '*' (including '*/n') carry the star bit:
+        # standard (robfig) cron ORs dom/dow only when BOTH lack it
+        self.dom_star = fields[2].startswith("*")
+        self.dow_star = fields[4].startswith("*")
 
     def _day_matches(self, tm: time.struct_time) -> bool:
         dom_ok = tm.tm_mday in self.dom
         dow_ok = ((tm.tm_wday + 1) % 7) in self.dow  # struct_time: Mon=0
-        if self.dom_star and self.dow_star:
-            return True
-        if self.dom_star:
-            return dow_ok
-        if self.dow_star:
-            return dom_ok
+        # robfig/cron: day fields combine with OR only when BOTH are
+        # restricted (no star bit); otherwise both must match — a pure '*'
+        # matches every day anyway, while '*/2' still restricts
+        if self.dom_star or self.dow_star:
+            return dom_ok and dow_ok
         return dom_ok or dow_ok
 
     def matches(self, epoch: float) -> bool:

@@ -1,0 +1,78 @@
+"""Minimal JSONPath for -o jsonpath= output.
+
+Parity target: the subset of reference pkg/util/jsonpath used by kubectl
+one-liners: `{.path.to[0].field}`, `{.items[*].metadata.name}`, `{range
+.items[*]}...{end}` is NOT supported — multiple `{...}` templates are joined
+with the literal text between them."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List
+
+
+class JSONPathError(ValueError):
+    pass
+
+
+_SEGMENT = re.compile(r"\.([A-Za-z0-9_\-]+)|\[(\*|-?\d+)\]")
+
+
+def _walk(value: Any, path: str) -> List[Any]:
+    """Evaluate one {.a.b[*].c} body against value; returns matches."""
+    values = [value]
+    pos = 0
+    while pos < len(path):
+        m = _SEGMENT.match(path, pos)
+        if not m:
+            raise JSONPathError(f"unrecognized path at {path[pos:]!r}")
+        pos = m.end()
+        field, index = m.group(1), m.group(2)
+        nxt: List[Any] = []
+        for v in values:
+            if field is not None:
+                if isinstance(v, dict) and field in v:
+                    nxt.append(v[field])
+            elif index == "*":
+                if isinstance(v, list):
+                    nxt.extend(v)
+            else:
+                i = int(index)
+                if isinstance(v, list) and -len(v) <= i < len(v):
+                    nxt.append(v[i])
+        values = nxt
+    return values
+
+
+def evaluate(template: str, data: Any) -> str:
+    """Expand a jsonpath template: text outside {} is literal, each {.path}
+    is replaced by its matches joined with spaces."""
+    out = []
+    pos = 0
+    while pos < len(template):
+        start = template.find("{", pos)
+        if start < 0:
+            out.append(template[pos:])
+            break
+        out.append(template[pos:start])
+        end = template.find("}", start)
+        if end < 0:
+            raise JSONPathError("unclosed '{' in jsonpath template")
+        body = template[start + 1:end].strip()
+        if not body.startswith("."):
+            raise JSONPathError(f"path must start with '.': {body!r}")
+        matches = _walk(data, body)
+        out.append(" ".join(_fmt(m) for m in matches))
+        pos = end + 1
+    return "".join(out)
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return ""
+    if isinstance(v, (dict, list)):
+        import json
+        return json.dumps(v, separators=(",", ":"))
+    return str(v)

@@ -1,0 +1,124 @@
+"""Strategic merge patch (dict form).
+
+Parity target: reference pkg/util/strategicpatch/patch.go — the three-way
+merge `kubectl apply` performs. Semantics implemented:
+
+  - maps merge recursively; a key set to None in the patch deletes it
+  - lists of maps that carry a merge key (containers/ports/volumes/env -> by
+    name; no struct tags here, so the well-known merge keys are a table)
+    merge element-wise by key; other lists REPLACE wholesale
+  - three-way: changes = diff(original, modified) plus deletions for keys in
+    original missing from modified; then patch applied to current
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+# field name -> merge key (reference struct tags patchMergeKey)
+MERGE_KEYS = {
+    "containers": "name",
+    "volumes": "name",
+    "env": "name",
+    "ports": "containerPort",
+    "volumeMounts": "mountPath",
+    "subsets": None,  # replace
+}
+
+
+def create_two_way_merge_patch(original: Dict, modified: Dict) -> Dict:
+    """Patch that turns original into modified."""
+    patch: Dict[str, Any] = {}
+    for k, mv in modified.items():
+        ov = original.get(k)
+        if k not in original:
+            patch[k] = copy.deepcopy(mv)
+        elif isinstance(ov, dict) and isinstance(mv, dict):
+            sub = create_two_way_merge_patch(ov, mv)
+            if sub:
+                patch[k] = sub
+        elif (isinstance(ov, list) and isinstance(mv, list)
+              and _merge_key_for(k)
+              and all(isinstance(e, dict) for e in ov + mv)):
+            sub_list = _list_diff(ov, mv, _merge_key_for(k))
+            if sub_list:
+                patch[k] = sub_list
+        elif ov != mv:
+            patch[k] = copy.deepcopy(mv)
+    for k in original:
+        if k not in modified:
+            patch[k] = None  # deletion directive
+    return patch
+
+
+def _list_diff(original: List[Dict], modified: List[Dict],
+               key: str) -> List[Dict]:
+    """Element-wise patch for a merge-keyed list: changed/new elements plus
+    `{"$patch": "delete", key: v}` directives for removed ones (reference
+    patch.go diffLists)."""
+    out: List[Dict] = []
+    orig_by_key = {e.get(key): e for e in original if e.get(key) is not None}
+    mod_keys = {e.get(key) for e in modified}
+    for me in modified:
+        mk = me.get(key)
+        oe = orig_by_key.get(mk)
+        if oe is None:
+            out.append(copy.deepcopy(me))
+            continue
+        sub = create_two_way_merge_patch(oe, me)
+        if sub:
+            sub[key] = mk  # the merge key always rides along
+            out.append(sub)
+    for ok in orig_by_key:
+        if ok not in mod_keys:
+            out.append({"$patch": "delete", key: ok})
+    return out
+
+
+def apply_patch(current: Dict, patch: Dict) -> Dict:
+    out = copy.deepcopy(current)
+    for k, pv in patch.items():
+        if pv is None:
+            out.pop(k, None)
+            continue
+        cv = out.get(k)
+        if isinstance(pv, dict) and isinstance(cv, dict):
+            out[k] = apply_patch(cv, pv)
+        elif isinstance(pv, list) and isinstance(cv, list) and \
+                _merge_key_for(k):
+            out[k] = _merge_lists(cv, pv, _merge_key_for(k))
+        else:
+            out[k] = copy.deepcopy(pv)
+    return out
+
+
+def three_way_merge(original: Dict, modified: Dict, current: Dict) -> Dict:
+    """What `kubectl apply` computes: apply (original->modified) changes on
+    top of current, preserving fields others set on current."""
+    patch = create_two_way_merge_patch(original, modified)
+    return apply_patch(current, patch)
+
+
+def _merge_key_for(field: str) -> Optional[str]:
+    return MERGE_KEYS.get(field)
+
+
+def _merge_lists(current: List, patch: List, key: str) -> List:
+    """Element-wise merge of lists of dicts by merge key; patch order wins
+    for new elements, current order preserved for existing ones."""
+    if not all(isinstance(e, dict) for e in list(current) + list(patch)):
+        return copy.deepcopy(patch)
+    out = copy.deepcopy(current)
+    for pe in patch:
+        pk = pe.get(key)
+        if pe.get("$patch") == "delete":
+            out = [e for e in out if e.get(key) != pk]
+            continue
+        idx = next((i for i, e in enumerate(out)
+                    if pk is not None and e.get(key) == pk), None)
+        if idx is not None:
+            out[idx] = apply_patch(out[idx], pe)
+        else:
+            out.append(copy.deepcopy(pe))
+    return out

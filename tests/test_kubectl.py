@@ -1,0 +1,353 @@
+"""kubectl CLI against a live in-process apiserver (reference
+pkg/kubectl/cmd/*_test.go + hack/test-cmd.sh shapes)."""
+
+import json
+
+import pytest
+import yaml
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.kubectl.cmd import main
+from kubernetes_tpu.utils import jsonpath, strategicpatch
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server)
+
+
+@pytest.fixture()
+def kubectl(server, capsys):
+    def run(*argv, expect=0):
+        rc = main(["-s", f"127.0.0.1:{server.port}", *argv])
+        captured = capsys.readouterr()
+        assert rc == expect, f"rc={rc} stderr={captured.err}"
+        return captured.out
+    return run
+
+
+def _mk_pod(client, name, labels=None, node="", phase="Running"):
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                labels=labels or {}),
+        spec=api.PodSpec(
+            node_name="",
+            containers=[api.Container(name="c", image="pause")]))
+    created = client.create("pods", pod, "default")
+    if phase:
+        created.status = api.PodStatus(phase=phase)
+        client.update_status("pods", created)
+    return created
+
+
+class TestGet:
+    def test_get_pods_table(self, kubectl, client):
+        _mk_pod(client, "alpha")
+        _mk_pod(client, "beta")
+        out = kubectl("get", "pods")
+        assert "NAME" in out and "STATUS" in out
+        assert "alpha" in out and "beta" in out
+        assert "Running" in out
+
+    def test_get_single_json_and_jsonpath(self, kubectl, client):
+        _mk_pod(client, "alpha")
+        out = kubectl("get", "pods", "alpha", "-o", "json")
+        d = json.loads(out)
+        assert d["kind"] == "Pod" and d["metadata"]["name"] == "alpha"
+        out = kubectl("get", "pods", "alpha", "-o",
+                      "jsonpath={.metadata.name}")
+        assert out.strip() == "alpha"
+
+    def test_get_by_slash_and_shortname(self, kubectl, client):
+        _mk_pod(client, "alpha")
+        out = kubectl("get", "po/alpha")
+        assert "alpha" in out
+
+    def test_get_yaml_list(self, kubectl, client):
+        _mk_pod(client, "a")
+        _mk_pod(client, "b")
+        out = kubectl("get", "pods", "-o", "yaml")
+        d = yaml.safe_load(out)
+        assert d["kind"] == "List" and len(d["items"]) == 2
+
+    def test_get_selector(self, kubectl, client):
+        _mk_pod(client, "a", labels={"app": "x"})
+        _mk_pod(client, "b", labels={"app": "y"})
+        out = kubectl("get", "pods", "-l", "app=x", "-o", "name")
+        assert out.strip() == "pod/a"
+
+
+class TestCreateApplyDelete:
+    def test_create_from_yaml(self, kubectl, tmp_path):
+        f = tmp_path / "pod.yaml"
+        f.write_text(yaml.safe_dump({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "made", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "img:1"}]}}))
+        out = kubectl("create", "-f", str(f))
+        assert 'pod "made" created' in out
+        out = kubectl("get", "pods", "made", "-o",
+                      "jsonpath={.spec.containers[0].image}")
+        assert out.strip() == "img:1"
+
+    def test_create_multidoc(self, kubectl, tmp_path):
+        f = tmp_path / "multi.yaml"
+        f.write_text(
+            yaml.safe_dump({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "one"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
+            + "---\n" +
+            yaml.safe_dump({
+                "apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": "two"},
+                "spec": {"selector": {"a": "b"},
+                         "ports": [{"port": 80}]}}))
+        out = kubectl("create", "-f", str(f))
+        assert "created" in out
+        assert kubectl("get", "svc", "two", "-o",
+                       "jsonpath={.metadata.name}").strip() == "two"
+
+    def test_apply_create_then_update(self, kubectl, tmp_path, client):
+        doc = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "app1", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "img:v1"}]}}
+        f = tmp_path / "p.yaml"
+        f.write_text(yaml.safe_dump(doc))
+        assert 'created' in kubectl("apply", "-f", str(f))
+        # out-of-band change to an unrelated field survives apply
+        live = client.get("pods", "app1", "default")
+        live.metadata.labels = {"added-by": "other"}
+        client.update("pods", live, "default")
+        doc["spec"]["containers"][0]["image"] = "img:v2"
+        f.write_text(yaml.safe_dump(doc))
+        assert 'configured' in kubectl("apply", "-f", str(f))
+        after = client.get("pods", "app1", "default")
+        assert after.spec.containers[0].image == "img:v2"
+        assert (after.metadata.labels or {}).get("added-by") == "other"
+
+    def test_delete_by_name_selector_all(self, kubectl, client):
+        _mk_pod(client, "a", labels={"app": "x"})
+        _mk_pod(client, "b", labels={"app": "x"})
+        _mk_pod(client, "keep")
+        out = kubectl("delete", "pods", "-l", "app=x")
+        assert out.count("deleted") == 2
+        assert kubectl("get", "pods", "-o", "name").strip() == "pod/keep"
+
+
+class TestScaleRollout:
+    def test_scale_rc(self, kubectl, client):
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc1", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=1, selector={"app": "rc1"},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "rc1"}),
+                    spec=api.PodSpec(containers=[
+                        api.Container(name="c", image="i")]))))
+        client.create("replicationcontrollers", rc, "default")
+        kubectl("scale", "rc", "rc1", "--replicas=5")
+        assert client.get("replicationcontrollers", "rc1",
+                          "default").spec.replicas == 5
+
+
+class TestNodeOps:
+    def _mk_node(self, client, name):
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name=name),
+            status=api.NodeStatus(conditions=[api.NodeCondition(
+                type="Ready", status="True")])))
+
+    def test_cordon_uncordon(self, kubectl, client):
+        self._mk_node(client, "n1")
+        kubectl("cordon", "n1")
+        assert client.get("nodes", "n1").spec.unschedulable is True
+        out = kubectl("get", "nodes")
+        assert "SchedulingDisabled" in out
+        kubectl("uncordon", "n1")
+        assert client.get("nodes", "n1").spec.unschedulable is False
+
+    def test_drain_evicts_managed_pods(self, kubectl, client):
+        self._mk_node(client, "n1")
+        p = api.Pod(
+            metadata=api.ObjectMeta(
+                name="victim", namespace="default",
+                owner_references=[api.OwnerReference(
+                    kind="ReplicaSet", name="rs", uid="u1")]),
+            spec=api.PodSpec(containers=[api.Container(name="c", image="i")]))
+        created = client.create("pods", p, "default")
+        client.bind(api.Binding(
+            metadata=api.ObjectMeta(name="victim", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n1")), "default")
+        out = kubectl("drain", "n1")
+        assert 'pod "victim" evicted' in out
+        assert client.get("nodes", "n1").spec.unschedulable is True
+
+    def test_drain_refuses_unmanaged_without_force(self, kubectl, client):
+        self._mk_node(client, "n2")
+        client.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="bare", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(name="c", image="i")])),
+            "default")
+        client.bind(api.Binding(
+            metadata=api.ObjectMeta(name="bare", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n2")), "default")
+        kubectl("drain", "n2", expect=1)
+        assert client.get("pods", "bare", "default")  # survived
+        kubectl("drain", "n2", "--force")
+
+
+class TestRunExposeLabel:
+    def test_run_creates_rc(self, kubectl, client):
+        kubectl("run", "web", "--image=nginx", "--replicas=2")
+        rc = client.get("replicationcontrollers", "web", "default")
+        assert rc.spec.replicas == 2
+        assert rc.spec.template.spec.containers[0].image == "nginx"
+
+    def test_run_restart_never_creates_pod(self, kubectl, client):
+        kubectl("run", "onep", "--image=img", "--restart=Never")
+        assert client.get("pods", "onep", "default")
+
+    def test_expose_rc(self, kubectl, client):
+        kubectl("run", "web", "--image=nginx")
+        kubectl("expose", "rc", "web", "--port=80")
+        svc = client.get("services", "web", "default")
+        assert svc.spec.selector == {"run": "web"}
+        assert svc.spec.ports[0].port == 80
+
+    def test_label_and_annotate(self, kubectl, client):
+        _mk_pod(client, "p1")
+        kubectl("label", "pods", "p1", "tier=web")
+        assert client.get("pods", "p1",
+                          "default").metadata.labels["tier"] == "web"
+        kubectl("label", "pods", "p1", "tier=db", expect=1)  # no overwrite
+        kubectl("label", "pods", "p1", "tier=db", "--overwrite")
+        assert client.get("pods", "p1",
+                          "default").metadata.labels["tier"] == "db"
+        kubectl("label", "pods", "p1", "tier-")
+        assert "tier" not in (client.get("pods", "p1",
+                                         "default").metadata.labels or {})
+        kubectl("annotate", "pods", "p1", "note=hello")
+        assert client.get("pods", "p1",
+                          "default").metadata.annotations["note"] == "hello"
+
+    def test_autoscale(self, kubectl, client):
+        kubectl("run", "web", "--image=nginx")
+        kubectl("autoscale", "rc", "web", "--max=8", "--cpu-percent=70")
+        hpa = client.get("horizontalpodautoscalers", "web", "default")
+        assert hpa.spec.max_replicas == 8
+        assert hpa.spec.scale_target_ref.kind == "ReplicationController"
+
+
+class TestMisc:
+    def test_version_and_apiversions(self, kubectl):
+        assert "Client Version" in kubectl("version")
+        out = kubectl("api-versions")
+        assert "v1" in out and "extensions/v1beta1" in out
+
+    def test_describe_pod(self, kubectl, client):
+        _mk_pod(client, "descme", labels={"a": "b"})
+        out = kubectl("describe", "pods", "descme")
+        assert "Name:\tdescme" in out
+        assert "a=b" in out
+        assert "Image:\tpause" in out
+
+
+class TestJSONPathUnit:
+    def test_basic_paths(self):
+        data = {"metadata": {"name": "x"},
+                "items": [{"v": 1}, {"v": 2}]}
+        assert jsonpath.evaluate("{.metadata.name}", data) == "x"
+        assert jsonpath.evaluate("{.items[*].v}", data) == "1 2"
+        assert jsonpath.evaluate("{.items[0].v}/{.items[-1].v}", data) == "1/2"
+        assert jsonpath.evaluate("name={.metadata.name}", data) == "name=x"
+
+    def test_errors(self):
+        with pytest.raises(jsonpath.JSONPathError):
+            jsonpath.evaluate("{metadata}", {})
+        with pytest.raises(jsonpath.JSONPathError):
+            jsonpath.evaluate("{.a", {})
+
+
+class TestStrategicPatchUnit:
+    def test_three_way_preserves_cluster_fields(self):
+        original = {"spec": {"replicas": 1, "template": {"x": 1}}}
+        modified = {"spec": {"replicas": 3, "template": {"x": 1}}}
+        current = {"spec": {"replicas": 1, "template": {"x": 1},
+                            "clusterIP": "10.0.0.1"},
+                   "status": {"observed": 1}}
+        out = strategicpatch.three_way_merge(original, modified, current)
+        assert out["spec"]["replicas"] == 3
+        assert out["spec"]["clusterIP"] == "10.0.0.1"
+        assert out["status"] == {"observed": 1}
+
+    def test_deletion_directive(self):
+        original = {"metadata": {"labels": {"a": "1", "b": "2"}}}
+        modified = {"metadata": {"labels": {"a": "1"}}}
+        current = {"metadata": {"labels": {"a": "1", "b": "2", "c": "3"}}}
+        out = strategicpatch.three_way_merge(original, modified, current)
+        assert out["metadata"]["labels"] == {"a": "1", "c": "3"}
+
+    def test_container_list_merged_by_name(self):
+        current = {"containers": [{"name": "a", "image": "a:1"},
+                                  {"name": "b", "image": "b:1"}]}
+        patch = {"containers": [{"name": "a", "image": "a:2"}]}
+        out = strategicpatch.apply_patch(current, patch)
+        assert out["containers"] == [{"name": "a", "image": "a:2"},
+                                     {"name": "b", "image": "b:1"}]
+
+    def test_removed_list_element_emits_delete_directive(self):
+        original = {"env": [{"name": "A", "value": "1"},
+                            {"name": "B", "value": "2"}]}
+        modified = {"env": [{"name": "A", "value": "1"}]}
+        current = {"env": [{"name": "A", "value": "1"},
+                           {"name": "B", "value": "2"},
+                           {"name": "C", "value": "3"}]}
+        out = strategicpatch.three_way_merge(original, modified, current)
+        names = [e["name"] for e in out["env"]]
+        assert "B" not in names          # removed from manifest -> removed
+        assert "C" in names              # cluster-added element survives
+
+
+class TestCronDayBits:
+    def test_star_step_dom_still_restricts(self):
+        from kubernetes_tpu.utils import cron
+        s = cron.parse("0 0 */2 * *")
+        import time as _t
+        nxt = s.next_after(0)  # epoch day 1 (Jan 1) matches */2 from day 1
+        assert _t.gmtime(nxt).tm_mday in range(1, 32, 2)
+
+    def test_restricted_dom_and_dow_or_combine(self):
+        from kubernetes_tpu.utils import cron
+        s = cron.parse("0 0 13 * 5")  # 13th OR Fridays
+        import time as _t
+        t = s.next_after(0)
+        tm = _t.gmtime(t)
+        assert tm.tm_mday == 13 or tm.tm_wday == 4
+
+
+class TestDeleteFileNamespace:
+    def test_delete_f_honors_manifest_namespace(self, kubectl, client,
+                                                tmp_path):
+        doc = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "nsd", "namespace": "team-z"},
+               "spec": {"containers": [{"name": "c", "image": "i"}]}}
+        f = tmp_path / "p.yaml"
+        f.write_text(yaml.safe_dump(doc))
+        kubectl("create", "-f", str(f))
+        assert client.get("pods", "nsd", "team-z")
+        kubectl("delete", "-f", str(f))
+        import pytest as _pytest
+        from kubernetes_tpu.client.rest import ApiError
+        with _pytest.raises(ApiError):
+            client.get("pods", "nsd", "team-z")
