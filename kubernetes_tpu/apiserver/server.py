@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from kubernetes_tpu.api import binary_codec
 from kubernetes_tpu.api import fields as fieldsel
 from kubernetes_tpu.api import labels as labelsel
 from kubernetes_tpu.api import types as api
@@ -125,10 +126,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     # --- helpers -------------------------------------------------------------
 
+    def _wants_binary(self) -> bool:
+        return binary_codec.CONTENT_TYPE in (self.headers.get("Accept") or "")
+
     def _send_json(self, code: int, payload: dict):
-        body = json.dumps(payload, separators=(",", ":")).encode()
+        # content negotiation (reference negotiateOutputSerializer): clients
+        # accepting the binary type get the magic-prefixed wire form
+        if self._wants_binary():
+            body = binary_codec.encode_dict(payload)
+            ctype = binary_codec.CONTENT_TYPE
+        else:
+            body = json.dumps(payload, separators=(",", ":")).encode()
+            ctype = "application/json"
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -146,6 +157,12 @@ class _Handler(BaseHTTPRequestHandler):
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
         raw = self.rfile.read(length) if length else b"{}"
+        ctype = self.headers.get("Content-Type") or ""
+        if binary_codec.CONTENT_TYPE in ctype or binary_codec.is_binary(raw):
+            try:
+                return binary_codec.decode_dict(raw)
+            except binary_codec.BinaryCodecError as e:
+                raise bad_request(f"invalid binary body: {e}") from None
         try:
             return json.loads(raw)
         except json.JSONDecodeError as e:
@@ -469,26 +486,39 @@ class _Handler(BaseHTTPRequestHandler):
             raise bad_request(f"invalid resourceVersion: {since!r}") from None
         watcher = self.registry.watch(resource, ns, since_rv=since_rv)
         rd = RESOURCES[resource]
+        binary = self._wants_binary()
         METRICS.inc("apiserver_watch_streams", resource=resource)
         self.send_response(200)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type",
+                         binary_codec.CONTENT_TYPE if binary
+                         else "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         try:
             while True:
                 ev = watcher.next(timeout=30.0)
                 if ev is None:
-                    # heartbeat: a blank line (clients skip it) so a dead TCP
-                    # peer raises BrokenPipe and we reclaim thread + watcher
-                    self._write_chunk(b"\n")
+                    # heartbeat: blank line (JSON) / zero-length frame
+                    # (binary) so a dead TCP peer raises BrokenPipe and we
+                    # reclaim thread + watcher
+                    self._write_chunk(b"\x00\x00\x00\x00" if binary
+                                      else b"\n")
                     continue
                 out = self._transform_for_selectors(rd, ev, lsel, fsel)
                 if out is None:
                     continue
                 etype, obj = out
-                frame = json.dumps({"type": etype,
-                                    "object": scheme.encode(obj)},
-                                   separators=(",", ":")).encode() + b"\n"
+                if binary:
+                    # length-delimited binary event frames (reference
+                    # protobuf watch framing, pkg/runtime/serializer/
+                    # protobuf + util/framer LengthDelimitedFramer)
+                    payload = binary_codec.encode_dict(
+                        {"type": etype, "object": scheme.encode(obj)})
+                    frame = len(payload).to_bytes(4, "big") + payload
+                else:
+                    frame = json.dumps({"type": etype,
+                                        "object": scheme.encode(obj)},
+                                       separators=(",", ":")).encode() + b"\n"
                 self._write_chunk(frame)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass

@@ -14,6 +14,7 @@ import threading
 from typing import Iterator, Optional, Tuple
 from urllib.parse import quote
 
+from kubernetes_tpu.api import binary_codec
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.serialization import from_dict, scheme, to_dict
 from kubernetes_tpu.registry.generic import RESOURCES
@@ -43,22 +44,51 @@ class ApiError(Exception):
 class WatchStream:
     """Iterator over watch frames; `stop()` closes the connection."""
 
-    def __init__(self, conn: http.client.HTTPConnection, resp, cls):
+    def __init__(self, conn: http.client.HTTPConnection, resp, cls,
+                 binary: bool = False):
         self._conn = conn
         self._resp = resp
         self._cls = cls
+        self._binary = binary
         self._stopped = False
 
-    def __iter__(self) -> Iterator[Tuple[str, object]]:
-        try:
-            while not self._stopped:
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._resp.read(n - len(buf))
+            if not chunk:
+                return b""
+            buf += chunk
+        return buf
+
+    def _frames(self):
+        if not self._binary:
+            while True:
                 line = self._resp.readline()
                 if not line:
                     return
                 line = line.strip()
                 if not line:
                     continue  # heartbeat
-                frame = json.loads(line)
+                yield json.loads(line)
+        else:
+            while True:
+                hdr = self._read_exact(4)
+                if len(hdr) < 4:
+                    return
+                length = int.from_bytes(hdr, "big")
+                if length == 0:
+                    continue  # heartbeat frame
+                payload = self._read_exact(length)
+                if len(payload) < length:
+                    return
+                yield binary_codec.decode_dict(payload)
+
+    def __iter__(self) -> Iterator[Tuple[str, object]]:
+        try:
+            for frame in self._frames():
+                if self._stopped:
+                    return
                 obj = from_dict(self._cls, frame["object"])
                 yield frame["type"], obj
         except (http.client.HTTPException, OSError, ValueError, AttributeError):
@@ -94,13 +124,17 @@ class RESTClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 8080,
                  qps: float = 50.0, burst: int = 100,
                  user_agent: str = "kubernetes-tpu-client", timeout: float = 30.0,
-                 bearer_token: str = "", basic_auth: Optional[tuple] = None):
+                 bearer_token: str = "", basic_auth: Optional[tuple] = None,
+                 content_type: str = "application/json"):
         self.host = host
         self.port = port
         self.timeout = timeout
         self.user_agent = user_agent
         self.bearer_token = bearer_token
         self.basic_auth = basic_auth  # (user, password)
+        # application/vnd.kubernetes.protobuf selects the binary wire codec
+        # (reference --kube-api-content-type; kubemark defaults to it)
+        self.content_type = content_type
         self._limiter = TokenBucket(qps, burst)
         self._local = threading.local()
 
@@ -130,10 +164,18 @@ class RESTClient:
 
     def request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         self._limiter.accept()
-        payload = json.dumps(body).encode() if body is not None else None
+        binary = self.content_type == binary_codec.CONTENT_TYPE
+        if body is None:
+            payload = None
+        elif binary:
+            payload = binary_codec.encode_dict(body)
+        else:
+            payload = json.dumps(body).encode()
         headers = {"User-Agent": self.user_agent}
+        if binary:
+            headers["Accept"] = binary_codec.CONTENT_TYPE
         if payload is not None:
-            headers["Content-Type"] = "application/json"
+            headers["Content-Type"] = self.content_type
         self._auth_headers(headers)
         for attempt in (1, 2):
             conn = self._conn()
@@ -157,7 +199,12 @@ class RESTClient:
                 if method == "GET" and attempt == 1:
                     continue
                 raise
-        parsed = json.loads(data) if data else {}
+        if not data:
+            parsed = {}
+        elif binary_codec.is_binary(data):
+            parsed = binary_codec.decode_dict(data)
+        else:
+            parsed = json.loads(data)
         if resp.status >= 400:
             raise ApiError(resp.status, parsed.get("reason", "Unknown"),
                            parsed.get("message", ""))
@@ -267,15 +314,23 @@ class RESTClient:
         path = self._collection_path(resource, namespace) + self._query(
             label_selector, field_selector, watch="true",
             resourceVersion=resource_version)
+        binary = self.content_type == binary_codec.CONTENT_TYPE
         conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout + 35)
         headers = {"User-Agent": self.user_agent}
+        if binary:
+            headers["Accept"] = binary_codec.CONTENT_TYPE
         self._auth_headers(headers)
         conn.request("GET", path, headers=headers)
         resp = conn.getresponse()
         if resp.status >= 400:
             data = resp.read()
-            parsed = json.loads(data) if data else {}
+            if not data:
+                parsed = {}
+            elif binary_codec.is_binary(data):
+                parsed = binary_codec.decode_dict(data)
+            else:
+                parsed = json.loads(data)
             conn.close()
             raise ApiError(resp.status, parsed.get("reason", "Unknown"),
                            parsed.get("message", ""))
-        return WatchStream(conn, resp, RESOURCES[resource].cls)
+        return WatchStream(conn, resp, RESOURCES[resource].cls, binary=binary)

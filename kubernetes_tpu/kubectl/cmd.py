@@ -409,9 +409,12 @@ def cmd_drain(args) -> int:
     unmanaged/daemon pods unless forced)."""
     client = _client(args)
     for name in args.args:
-        _set_unschedulable(client, name, True)
         pods, _ = client.list("pods",
                               field_selector=f"spec.nodeName={name}")
+        # validate the FULL pod list before touching anything (reference
+        # drain.go GetPodsForDeletion refuses up front) so a failure never
+        # leaves the node partially drained
+        victims = []
         for p in pods:
             managed = bool((p.metadata.owner_references or [])
                            or api.ANN_CREATED_BY in
@@ -426,8 +429,10 @@ def cmd_drain(args) -> int:
                 raise CommandError(
                     f"pod {p.metadata.name} is not managed by a "
                     "controller; use --force to delete it")
-            if daemon:
-                continue  # daemon pods are left (their controller pins them)
+            if not daemon:  # daemon pods stay (their controller pins them)
+                victims.append(p)
+        _set_unschedulable(client, name, True)
+        for p in victims:
             client.delete("pods", p.metadata.name, p.metadata.namespace)
             print(f"pod \"{p.metadata.name}\" evicted")
         print(f"node \"{name}\" drained")

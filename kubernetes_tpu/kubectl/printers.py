@@ -171,11 +171,15 @@ def print_objs(resource: str, objs: List, output: Optional[str],
     if output == "yaml":
         return yaml.safe_dump(payload, default_flow_style=False)
     if output.startswith("jsonpath="):
+        # evaluate against the same payload json/yaml print, so the standard
+        # `{.items[*].metadata.name}` idiom works on multi-object output
         tpl = output[len("jsonpath="):]
-        return "\n".join(jsonpath.evaluate(tpl, scheme.encode(o))
-                         for o in objs)
+        return jsonpath.evaluate(tpl, payload)
     raise ValueError(f"unknown output format {output!r}")
 
 
 def _singular(resource: str) -> str:
-    return resource[:-1] if resource.endswith("s") else resource
+    from kubernetes_tpu.registry.generic import RESOURCES
+    rd = RESOURCES.get(resource)
+    return rd.kind.lower() if rd else (
+        resource[:-1] if resource.endswith("s") else resource)

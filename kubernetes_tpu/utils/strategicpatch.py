@@ -39,8 +39,7 @@ def create_two_way_merge_patch(original: Dict, modified: Dict) -> Dict:
             if sub:
                 patch[k] = sub
         elif (isinstance(ov, list) and isinstance(mv, list)
-              and _merge_key_for(k)
-              and all(isinstance(e, dict) for e in ov + mv)):
+              and _mergeable(k, ov + mv)):
             sub_list = _list_diff(ov, mv, _merge_key_for(k))
             if sub_list:
                 patch[k] = sub_list
@@ -86,7 +85,7 @@ def apply_patch(current: Dict, patch: Dict) -> Dict:
         if isinstance(pv, dict) and isinstance(cv, dict):
             out[k] = apply_patch(cv, pv)
         elif isinstance(pv, list) and isinstance(cv, list) and \
-                _merge_key_for(k):
+                _mergeable(k, cv + pv):
             out[k] = _merge_lists(cv, pv, _merge_key_for(k))
         else:
             out[k] = copy.deepcopy(pv)
@@ -102,6 +101,18 @@ def three_way_merge(original: Dict, modified: Dict, current: Dict) -> Dict:
 
 def _merge_key_for(field: str) -> Optional[str]:
     return MERGE_KEYS.get(field)
+
+
+def _mergeable(field: str, elements: List) -> bool:
+    """Merge-by-key applies only when EVERY element is a dict carrying the
+    key — e.g. Service ports have 'port' not 'containerPort', so a
+    same-named 'ports' field without the key falls back to whole-list
+    replacement instead of appending duplicates."""
+    key = _merge_key_for(field)
+    return bool(key) and all(
+        isinstance(e, dict) and e.get(key) is not None
+        or (isinstance(e, dict) and e.get("$patch") == "delete")
+        for e in elements)
 
 
 def _merge_lists(current: List, patch: List, key: str) -> List:

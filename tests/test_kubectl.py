@@ -85,6 +85,13 @@ class TestGet:
         out = kubectl("get", "pods", "-l", "app=x", "-o", "name")
         assert out.strip() == "pod/a"
 
+    def test_jsonpath_items_idiom_over_list(self, kubectl, client):
+        _mk_pod(client, "a")
+        _mk_pod(client, "b")
+        out = kubectl("get", "pods", "-o",
+                      "jsonpath={.items[*].metadata.name}")
+        assert out.split() == ["a", "b"]
+
 
 class TestCreateApplyDelete:
     def test_create_from_yaml(self, kubectl, tmp_path):
@@ -305,6 +312,15 @@ class TestStrategicPatchUnit:
         out = strategicpatch.apply_patch(current, patch)
         assert out["containers"] == [{"name": "a", "image": "a:2"},
                                      {"name": "b", "image": "b:1"}]
+
+    def test_keyless_ports_replace_not_append(self):
+        # Service ports carry 'port', not the containers' merge key — apply
+        # must replace the list, never append duplicates
+        original = {"ports": [{"port": 80}]}
+        modified = {"ports": [{"port": 80}]}
+        current = {"ports": [{"port": 80, "protocol": "TCP"}]}
+        out = strategicpatch.three_way_merge(original, modified, current)
+        assert len(out["ports"]) == 1
 
     def test_removed_list_element_emits_delete_directive(self):
         original = {"env": [{"name": "A", "value": "1"},

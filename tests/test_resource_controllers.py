@@ -234,7 +234,7 @@ class TestHorizontalController:
                 client.update("pods", p, "default")
 
             _wait(lambda: client.get("replicasets", "web", "default")
-                  .spec.replicas >= 4, timeout=15)
+                  .spec.replicas >= 4, timeout=40)
             hpa = client.get("horizontalpodautoscalers", "web-hpa", "default")
             assert hpa.status.desired_replicas >= 4
         finally:
