@@ -26,6 +26,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 N_NODES = int(os.environ.get("BENCH_NODES", 5000))
 N_PODS = int(os.environ.get("BENCH_PODS", 30000))
 
+METRIC = (f"pods_scheduled_per_sec @ {N_PODS // 1000}k pods / "
+          f"{N_NODES // 1000}k nodes (full default-provider kernel)")
+
+
+def _clear_backends():
+    from kubernetes_tpu.utils.platform import clear_backends_compat
+    clear_backends_compat()
+
 
 def build_cluster():
     from kubernetes_tpu.api import types as api
@@ -74,9 +82,106 @@ def build_cluster():
     return nodes, pending, [svc]
 
 
+def _reexec_cpu(reason: str):
+    """Re-exec this script in a fresh interpreter pinned to CPU.
+
+    Round-1/2 postmortem: the axon TPU backend can fail setup with
+    UNAVAILABLE *or hang indefinitely inside jax.devices()* (tunnel down —
+    no exception ever surfaces, so in-process retries are useless and a
+    hung thread can't be cleaned up). A fresh process with
+    PALLAS_AXON_POOL_IPS removed never registers the TPU platform at all.
+    An honest-but-slow CPU number beats a lost round.
+    """
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # already the CPU re-exec — a second hop can only loop forever;
+        # report what we have and stop
+        fail_json("cpu_fallback", RuntimeError(reason))
+        sys.exit(0)
+    print(f"bench: falling back to CPU via re-exec: {reason}", file=sys.stderr)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_TPU_ERR"] = reason[:500]
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def run_with_timeout(fn, seconds, stage):
+    """Run fn() on a daemon thread; (True, value) or raises on error; a hang
+    past `seconds` re-execs the whole bench on CPU (the thread can't be
+    killed, but a fresh interpreter can)."""
+    import threading
+
+    box = {}
+
+    def target():
+        try:
+            box["value"] = fn()
+        except Exception as e:
+            box["err"] = e
+
+    th = threading.Thread(target=target, daemon=True)
+    th.start()
+    th.join(timeout=seconds)
+    if th.is_alive():
+        _reexec_cpu(f"{stage} hung for {seconds}s")
+    if "err" in box:
+        raise box["err"]
+    return box["value"]
+
+
+def init_backend(max_tries=3):
+    """Initialize the jax backend; fall back to CPU (fresh process) if the
+    TPU errors persistently or hangs."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        _clear_backends()
+        return jax, jax.devices(), os.environ.get("BENCH_TPU_ERR", "forced")
+
+    import jax
+
+    last_err = None
+    for attempt in range(max_tries):
+        try:
+            def probe():
+                devs = jax.devices()
+                jax.block_until_ready(jax.numpy.zeros(8))
+                return devs
+            devs = run_with_timeout(
+                probe, float(os.environ.get("BENCH_INIT_TIMEOUT", 120)),
+                "backend init")
+            return jax, devs, None
+        except Exception as e:  # init failures surface as RuntimeError
+            last_err = e
+            print(f"bench: backend init attempt {attempt + 1}/{max_tries} "
+                  f"failed: {e}", file=sys.stderr)
+            try:
+                _clear_backends()
+            except Exception:
+                pass
+            if attempt < max_tries - 1:
+                time.sleep(min(5 * (2 ** attempt), 30))
+    _reexec_cpu(f"TPU init failed {max_tries}x: {last_err!r}")
+
+
+def fail_json(stage, err, **detail):
+    print(json.dumps({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "pods/s",
+        "vs_baseline": 0.0,
+        "error": {"stage": stage, "exception": repr(err), **detail},
+    }))
+
+
 def main():
     t_start = time.perf_counter()
-    import jax
+    try:
+        jax, devs, backend_err = init_backend()
+    except Exception as e:
+        fail_json("backend_init", e)
+        return
 
     from kubernetes_tpu.ops.kernel import Weights, _schedule_jit
     from kubernetes_tpu.ops.tensorize import Tensorizer
@@ -88,24 +193,44 @@ def main():
     args = make_plugin_args(nodes, service_lister=ListServiceLister(services))
     ct = Tensorizer(plugin_args=args).build(nodes, [], pending)
     t_tensorized = time.perf_counter()
+    print(f"bench: tensorized in {t_tensorized - t_built:.1f}s; "
+          f"device={devs[0]}", file=sys.stderr)
 
     import jax.numpy as jnp
-    arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
-    jax.block_until_ready(arrays)
+    try:
+        def upload():
+            arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
+            jax.block_until_ready(arrays)
+            return arrays
+        arrays = run_with_timeout(upload, 300, "upload")
+    except Exception as e:
+        fail_json("upload", e,
+                  tensorize_seconds=round(t_tensorized - t_built, 1))
+        return
     t_upload = time.perf_counter()
 
     weights = Weights()
-    out = _schedule_jit(arrays, ct.n_zones, weights)
-    jax.block_until_ready(out)
-    t_compiled = time.perf_counter()
+    try:
+        def compile_and_run():
+            out = _schedule_jit(arrays, ct.n_zones, weights)
+            jax.block_until_ready(out)
+            return out
+        out = run_with_timeout(compile_and_run, 900, "kernel compile")
+        t_compiled = time.perf_counter()
 
-    # steady state: same compiled program, fresh run
-    runs = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = _schedule_jit(arrays, ct.n_zones, weights)
-        jax.block_until_ready(out)
-        runs.append(time.perf_counter() - t0)
+        # steady state: same compiled program, fresh run
+        runs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = _schedule_jit(arrays, ct.n_zones, weights)
+            jax.block_until_ready(out)
+            runs.append(time.perf_counter() - t0)
+    except Exception as e:
+        fail_json("kernel", e,
+                  device=str(devs[0]),
+                  tensorize_seconds=round(t_tensorized - t_built, 1),
+                  upload_seconds=round(t_upload - t_tensorized, 1))
+        return
     best = min(runs)
 
     import numpy as np
@@ -121,7 +246,7 @@ def main():
 
     pods_per_sec = scheduled / best if best > 0 else 0.0
     result = {
-        "metric": f"pods_scheduled_per_sec @ {N_PODS // 1000}k pods / {N_NODES // 1000}k nodes (full default-provider kernel)",
+        "metric": METRIC,
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_sec / 30000.0, 3),
@@ -135,6 +260,8 @@ def main():
             "runs": [round(r, 4) for r in runs],
         },
     }
+    if backend_err is not None:
+        result["detail"]["tpu_fallback"] = backend_err
     print(json.dumps(result))
 
 

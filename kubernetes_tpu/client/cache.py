@@ -134,6 +134,16 @@ class FIFO:
             _, obj = self._items.popitem(last=False)
             return obj
 
+    def drain(self, max_n: int) -> list:
+        """Pop up to max_n queued items without blocking (batch-scheduler
+        intake: first pod blocks via pop(), the rest of the batch drains)."""
+        out = []
+        with self._lock:
+            while self._items and len(out) < max_n:
+                _, obj = self._items.popitem(last=False)
+                out.append(obj)
+        return out
+
     def close(self):
         with self._lock:
             self._closed = True

@@ -39,7 +39,9 @@ import numpy as np
 
 from kubernetes_tpu.ops.tensorize import ClusterTensors
 
-NEG = jnp.float32(-1e9)
+# numpy scalar, not jnp: module import must stay device-free (backend init
+# at import time would grab the chip even for CPU-only test runs)
+NEG = np.float32(-1e9)
 
 
 @dataclass(frozen=True)

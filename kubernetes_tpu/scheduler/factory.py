@@ -148,6 +148,14 @@ class ConfigFactory:
     def _create(self, algorithm) -> "Scheduler":
         return Scheduler(self, algorithm)
 
+    def create_batch_from_provider(self, provider_name: str = DEFAULT_PROVIDER,
+                                   batch_size: int = 4096, weights=None):
+        """The TPU-backed batch scheduler (scheduler/tpu.py) with the oracle
+        from the same provider as its device-failure fallback."""
+        from kubernetes_tpu.scheduler.tpu import create_batch_scheduler
+        return create_batch_scheduler(self, provider_name,
+                                      batch_size=batch_size, weights=weights)
+
     # --- lifecycle -----------------------------------------------------------
 
     def run(self, wait: bool = True, timeout: float = 10.0):
@@ -184,6 +192,10 @@ class Scheduler:
         pod = self.f.pending.pop(timeout=timeout)
         if pod is None:
             return False
+        self._schedule_pod(pod)
+        return True
+
+    def _schedule_pod(self, pod: api.Pod) -> None:
         t_start = time.perf_counter()
         try:
             info = self.f.cache.get_node_name_to_info_map()
@@ -192,7 +204,10 @@ class Scheduler:
                 dest = self.algorithm.schedule(pod, info, nodes)
         except Exception as e:  # FitError and scheduler bugs both requeue
             self._handle_failure(pod, e)
-            return True
+            return
+        self._assume_and_bind(pod, dest, t_start)
+
+    def _assume_and_bind(self, pod: api.Pod, dest: str, t_start: float) -> None:
         # optimistic assume before the async bind (scheduler.go:120-126)
         assumed = _with_node(pod, dest)
         try:
@@ -200,9 +215,14 @@ class Scheduler:
             did_assume = True
         except ValueError:
             did_assume = False  # already cached (requeue race); bind anyway
+        self._spawn_bind(pod, dest, t_start, did_assume)
+
+    def _spawn_bind(self, pod, dest, t_start, did_assume):
+        """Async bind dispatch; the batch scheduler overrides this with a
+        bounded pool (one thread per pod is fine at 1 pod/iteration, not at
+        4096)."""
         threading.Thread(target=self._bind, args=(pod, dest, t_start, did_assume),
                          daemon=True).start()
-        return True
 
     def _bind(self, pod: api.Pod, dest: str, t_start: float, did_assume: bool):
         binding = api.Binding(

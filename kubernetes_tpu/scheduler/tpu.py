@@ -1,0 +1,145 @@
+"""The TPU batch scheduling path, wired into the live scheduler shell.
+
+This is the in-process form of the plug-in boundary the reference reserves
+for exactly this kind of backend (plugin/pkg/scheduler/extender.go:39-173,
+provider registry factory/plugins.go): instead of scheduling one FIFO pod at
+a time through the sequential algorithm, the BatchScheduler drains the
+pending queue into a batch, tensorizes it against the schedulercache
+snapshot, runs the whole batch through the device kernel (ops/kernel.py) in
+one program, and assumes+binds every result through the identical
+assume/bind/backoff machinery the sequential loop uses
+(scheduler.go:93-155 semantics, N pods per iteration).
+
+Failure containment:
+- a pod the kernel can't place follows the normal FailedScheduling path
+  (event + PodScheduled=False + exponential backoff requeue);
+- a device/tensorize error falls back to the sequential oracle algorithm for
+  the whole drained batch, so a broken device degrades to reference behavior
+  instead of wedging the queue.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.ops.kernel import Weights
+from kubernetes_tpu.scheduler.factory import ConfigFactory, Scheduler
+from kubernetes_tpu.scheduler.generic import FitError
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+log = logging.getLogger("scheduler.tpu")
+
+
+class BatchScheduler(Scheduler):
+    """Scheduler whose hot loop is the batched device kernel.
+
+    `algorithm` is the sequential fallback (normally the oracle
+    GenericScheduler built from the same provider keys) used when the device
+    path fails.
+    """
+
+    def __init__(self, factory: ConfigFactory, algorithm,
+                 batch_size: int = 4096, weights: Optional[Weights] = None,
+                 bind_workers: int = 32):
+        super().__init__(factory, algorithm)
+        self.batch_size = batch_size
+        self.weights = weights or Weights()
+        self.kernel_batches = 0     # successful device batches
+        self.kernel_pods = 0        # pods placed via the device path
+        self.kernel_failures = 0    # device/tensorize errors (fell back)
+        from concurrent.futures import ThreadPoolExecutor
+        self._bind_pool = ThreadPoolExecutor(
+            max_workers=bind_workers, thread_name_prefix="binder")
+
+    def _spawn_bind(self, pod, dest, t_start, did_assume):
+        self._bind_pool.submit(self._bind, pod, dest, t_start, did_assume)
+
+    # --- one batch (the batched scheduleOne) ---------------------------------
+
+    def schedule_batch_once(self, timeout: Optional[float] = None) -> int:
+        """Drain up to batch_size pending pods and schedule them in one
+        device program. Returns the number of pods processed (0 on queue
+        timeout/close)."""
+        first = self.f.pending.pop(timeout=timeout)
+        if first is None:
+            return 0
+        pods = [first] + self.f.pending.drain(self.batch_size - 1)
+        t_start = time.perf_counter()
+
+        try:
+            info = self.f.cache.get_node_name_to_info_map()
+            nodes = self.f.node_lister.list()
+            if not nodes:
+                for pod in pods:
+                    self._handle_failure(pod, FitError(pod, {}))
+                return len(pods)
+            node_set = {n.metadata.name for n in nodes}
+            # every cached pod (incl. assumed ones from previous batches) on
+            # a schedulable node is device state; pods on excluded nodes
+            # still matter for nothing the kernel models per-node, so drop
+            existing = [p for name, ni in info.items() if name in node_set
+                        for p in ni.pods]
+            with METRICS.time("scheduler_scheduling_algorithm_latency_seconds"):
+                results = self._run_kernel(nodes, existing, pods)
+            if len(results) != len(pods):
+                raise RuntimeError(
+                    f"kernel returned {len(results)} results for "
+                    f"{len(pods)} pods")
+        except Exception as e:
+            self.kernel_failures += 1
+            log.warning("TPU batch of %d failed (%s); sequential fallback",
+                        len(pods), e)
+            for pod in pods:
+                self._schedule_pod(pod)
+            return len(pods)
+
+        self.kernel_batches += 1
+        for pod, dest in zip(pods, results):
+            if dest is None:
+                self._handle_failure(pod, FitError(pod, {
+                    "*": "kernel: no feasible node in batch"}))
+                continue
+            self.kernel_pods += 1
+            self._assume_and_bind(pod, dest, t_start)
+        return len(pods)
+
+    def _run_kernel(self, nodes: List[api.Node], existing: List[api.Pod],
+                    pending: List[api.Pod]) -> List[Optional[str]]:
+        from kubernetes_tpu.scheduler.batch import tpu_batch
+        return tpu_batch(nodes, existing, pending, self.f.plugin_args,
+                         self.weights)
+
+    # --- loop ----------------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.schedule_batch_once(timeout=0.5)
+            except Exception:
+                log.exception("scheduleBatchOnce crashed")  # HandleCrash
+
+    def stop(self):
+        super().stop()
+        self._bind_pool.shutdown(wait=False)
+
+
+def create_batch_scheduler(factory: ConfigFactory,
+                           provider_name: Optional[str] = None,
+                           batch_size: int = 4096,
+                           weights: Optional[Weights] = None) -> BatchScheduler:
+    """Build a BatchScheduler whose fallback algorithm is the oracle built
+    from the same provider (CreateFromProvider seam, factory.go:248-342)."""
+    from kubernetes_tpu.scheduler.generic import GenericScheduler
+    from kubernetes_tpu.scheduler.provider import (
+        DEFAULT_PROVIDER, get_predicates, get_priorities, get_provider,
+    )
+    prov = get_provider(provider_name or DEFAULT_PROVIDER)
+    predicates = get_predicates(prov["predicates"], factory.plugin_args)
+    priorities = get_priorities(prov["priorities"], factory.plugin_args)
+    algorithm = GenericScheduler(predicates, priorities)
+    return BatchScheduler(factory, algorithm, batch_size=batch_size,
+                          weights=weights)

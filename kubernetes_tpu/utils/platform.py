@@ -1,0 +1,39 @@
+"""Backend platform helpers.
+
+The environment's axon sitecustomize force-registers the TPU platform at
+interpreter startup whenever PALLAS_AXON_POOL_IPS is set, and its
+jax.config.update beats the JAX_PLATFORMS env var — so forcing CPU requires
+updating the live config AND dropping any initialized backends. Every
+CPU-only entrypoint (tests/conftest.py, bench.py's fallback, direct drives)
+shares this dance here instead of hand-copying it.
+"""
+
+import os
+
+
+def clear_backends_compat():
+    try:
+        from jax.extend.backend import clear_backends
+    except ImportError:  # older jax layouts
+        from jax._src.api import clear_backends  # type: ignore
+    clear_backends()
+
+
+def force_cpu(device_count: int = 0):
+    """Pin jax to the host CPU platform, optionally with N virtual devices.
+    Safe to call before or after jax's first import; must run before the
+    first device op."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if device_count:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={device_count}"
+            ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    clear_backends_compat()
+    return jax

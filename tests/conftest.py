@@ -2,17 +2,19 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
 exercised without TPU hardware (the driver separately dry-runs the multichip
-path; real-chip benching happens via bench.py). Must run before jax import.
+path; real-chip benching happens via bench.py).
 
-Note: the environment's axon sitecustomize force-registers the TPU platform
-when PALLAS_AXON_POOL_IPS is set, overriding JAX_PLATFORMS — drop it so
-pytest genuinely runs on the CPU mesh and never monopolizes the chip.
+force_cpu() does the full dance — env vars alone are NOT enough because the
+axon sitecustomize force-registers the TPU platform at interpreter startup
+and its jax.config.update beats JAX_PLATFORMS; without the config update +
+clear_backends the suite hangs trying to grab the chip.
 """
 
 import os
+import sys
 
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(device_count=8)

@@ -1,0 +1,228 @@
+"""The TPU batch scheduler wired into the live control plane.
+
+VERDICT round-1 #1: pods created through the API server must be bound by the
+kernel path (not the sequential oracle), with bindings identical to the
+oracle run of the same sequence. Mirrors the reference's integration pattern
+(test/integration/scheduler_test.go) with the batch algorithm behind the
+same ConfigFactory seam (plugin/pkg/scheduler/factory/factory.go:248-342).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.scheduler.batch import (
+    ListServiceLister, make_plugin_args, oracle_batch,
+)
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=5000, burst=5000)
+
+
+def mk_pod(name, cpu="100m", mem="256Mi", ns="default", labels=None,
+           selector=None, tolerations=None):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(
+            node_selector=selector,
+            tolerations=tolerations,
+            containers=[api.Container(
+                name="c", image="pause",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": cpu, "memory": mem}))]))
+
+
+def mk_node(name, cpu="4", mem="16Gi", pods="110", labels=None, taints=None,
+            ready=True):
+    labels = dict(labels or {})
+    labels.setdefault(api.LABEL_HOSTNAME, name)
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels),
+        spec=api.NodeSpec(taints=taints),
+        status=api.NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": pods},
+            conditions=[api.NodeCondition(
+                type="Ready", status="True" if ready else "False")]))
+
+
+def wait_scheduled(client, n, ns="default", timeout=60.0):
+    deadline = time.monotonic() + timeout
+    done = []
+    while time.monotonic() < deadline:
+        pods, _ = client.list("pods", ns)
+        done = [p for p in pods if p.spec.node_name]
+        if len(done) >= n:
+            return done
+        time.sleep(0.05)
+    raise AssertionError(f"only {len(done)}/{n} pods scheduled in {timeout}s")
+
+
+def build_cluster(client, n_nodes=6, n_pods=40):
+    """Nodes with zones/taints/labels + pods with selectors/tolerations so
+    the full kernel surface runs, created BEFORE the scheduler starts so the
+    FIFO drains them in one deterministic batch."""
+    nodes = []
+    for i in range(n_nodes):
+        labels = {api.LABEL_ZONE: f"z{i % 2}"}
+        if i % 3 == 0:
+            labels["disk"] = "ssd"
+        taints = ([api.Taint(key="ded", value="x", effect="NoSchedule")]
+                  if i == n_nodes - 1 else None)
+        n = mk_node(f"n-{i:02d}", labels=labels, taints=taints)
+        nodes.append(n)
+        client.create("nodes", n)
+    svc = api.Service(
+        metadata=api.ObjectMeta(name="web", namespace="default"),
+        spec=api.ServiceSpec(selector={"app": "web"},
+                             ports=[api.ServicePort(port=80)]))
+    client.create("services", svc)
+    pods = []
+    for i in range(n_pods):
+        kw = {}
+        if i % 5 == 0:
+            kw["selector"] = {"disk": "ssd"}
+        if i % 7 == 0:
+            kw["tolerations"] = [api.Toleration(key="ded", operator="Exists")]
+        p = mk_pod(f"pod-{i:03d}", labels={"app": "web" if i % 2 else "db"},
+                   **kw)
+        pods.append(p)
+        client.create("pods", p)
+    return nodes, pods, [svc]
+
+
+class TestBatchSchedulerE2E:
+    def test_kernel_path_binds_pods(self, client):
+        nodes, pods, services = build_cluster(client)
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(batch_size=128).run()
+        try:
+            done = wait_scheduled(client, len(pods))
+        finally:
+            sched.stop()
+            factory.stop()
+        # the device path, not the fallback, did the placing
+        assert sched.kernel_failures == 0
+        assert sched.kernel_batches >= 1
+        assert sched.kernel_pods == len(pods)
+        # constraints honored end-to-end
+        by_name = {n.metadata.name: n for n in nodes}
+        for p in done:
+            node = by_name[p.spec.node_name]
+            if p.spec.node_selector:
+                for k, v in p.spec.node_selector.items():
+                    assert (node.metadata.labels or {}).get(k) == v
+            if node.spec and node.spec.taints:
+                assert p.spec.tolerations, \
+                    f"{p.metadata.name} on tainted node without toleration"
+            conds = {c.type: c.status for c in (p.status.conditions or [])}
+            assert conds.get("PodScheduled") == "True"
+
+    def test_bindings_match_oracle(self, client):
+        """The live kernel run must produce byte-identical bindings to the
+        offline oracle over the same FIFO sequence (SURVEY §7 done-means)."""
+        nodes, pods, services = build_cluster(client)
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(batch_size=128).run()
+        try:
+            done = wait_scheduled(client, len(pods))
+        finally:
+            sched.stop()
+            factory.stop()
+        assert sched.kernel_failures == 0
+        live = {p.metadata.name: p.spec.node_name for p in done}
+
+        args = make_plugin_args(nodes,
+                                service_lister=ListServiceLister(services))
+        want = oracle_batch(nodes, [], pods, args)
+        expected = {p.metadata.name: host
+                    for p, host in zip(pods, want) if host is not None}
+        assert live == expected
+
+    def test_unschedulable_pod_takes_failure_path(self, client):
+        client.create("nodes", mk_node("only", cpu="1"))
+        client.create("pods", mk_pod("fits", cpu="500m"))
+        client.create("pods", mk_pod("huge", cpu="64"))
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(batch_size=16).run()
+        try:
+            wait_scheduled(client, 1)
+            deadline = time.monotonic() + 10
+            cond = None
+            while time.monotonic() < deadline and cond is None:
+                pod = client.get("pods", "huge", "default")
+                for c in (pod.status.conditions or []):
+                    if c.type == "PodScheduled" and c.status == "False":
+                        cond = c
+                time.sleep(0.05)
+        finally:
+            sched.stop()
+            factory.stop()
+        assert cond is not None and cond.reason == "Unschedulable"
+        assert not client.get("pods", "huge", "default").spec.node_name
+
+    def test_device_failure_falls_back_to_oracle(self, client, monkeypatch):
+        """A broken device degrades to reference behavior, not a wedged
+        queue."""
+        client.create("nodes", mk_node("n1"))
+        client.create("pods", mk_pod("p1"))
+        client.create("pods", mk_pod("p2"))
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(batch_size=16)
+
+        def boom(nodes, existing, pending):
+            raise RuntimeError("device exploded")
+
+        monkeypatch.setattr(sched, "_run_kernel", boom)
+        sched.run()
+        try:
+            done = wait_scheduled(client, 2)
+        finally:
+            sched.stop()
+            factory.stop()
+        assert sched.kernel_failures >= 1
+        assert {p.spec.node_name for p in done} == {"n1"}
+
+    def test_second_batch_sees_first_batch_assumes(self, client):
+        """Capacity booked by batch 1 constrains batch 2 (the cross-batch
+        analogue of AssumePod, cache.go:101)."""
+        client.create("nodes", mk_node("small", cpu="1", pods="4"))
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(batch_size=64).run()
+        try:
+            # batch 1: two pods fill the node's cpu
+            client.create("pods", mk_pod("a1", cpu="500m"))
+            client.create("pods", mk_pod("a2", cpu="500m"))
+            wait_scheduled(client, 2)
+            # batch 2: no cpu left
+            client.create("pods", mk_pod("b1", cpu="500m"))
+            deadline = time.monotonic() + 10
+            cond = None
+            while time.monotonic() < deadline and cond is None:
+                pod = client.get("pods", "b1", "default")
+                for c in (pod.status.conditions or []):
+                    if c.type == "PodScheduled" and c.status == "False":
+                        cond = c
+                time.sleep(0.05)
+        finally:
+            sched.stop()
+            factory.stop()
+        assert cond is not None
+        assert not client.get("pods", "b1", "default").spec.node_name
