@@ -128,9 +128,44 @@ class ClusterTensors:
     image_node_sizes: np.ndarray    # [N, I] f32 (MiB present per image)
     pod_images: np.ndarray          # [P, I] f32
 
-    # inter-pod affinity (vs existing pods; static)
-    interpod_forbidden: np.ndarray  # [P, N] f32 (1 = blocked: anti/symmetry)
-    interpod_required_miss: np.ndarray  # [P, N] f32 (1 = hard affinity unmet)
+    # inter-pod affinity term tables (predicates.go:769-947,
+    # interpod_affinity.go:86-216). K = topology-key vocab; TR/TA/TP =
+    # required-affinity / required-anti-affinity / preferred terms owned by
+    # *pending* pods (in-batch dynamics ride the scan carry); TS/TE = terms
+    # owned by *existing* pods (static, applied in static_pass).
+    node_dom: np.ndarray            # [K, N] i32 domain id per topo key (-1 none)
+    req_topo: np.ndarray            # [TR, K] f32 term -> topo keys (empty key = defaults)
+    req_own: np.ndarray             # [P, TR] f32 ownership counts
+    req_match: np.ndarray           # [TR, P] f32 pending pod matches term
+    req_hit0: np.ndarray            # [TR, N] f32 0/1 existing match in node's domain
+    req_nomatch0: np.ndarray        # [TR] bool no existing pod matches anywhere
+    anti_topo: np.ndarray           # [TA, K] f32
+    anti_own: np.ndarray            # [P, TA] f32
+    anti_match: np.ndarray          # [TA, P] f32
+    anti_hit0: np.ndarray           # [TA, N] f32
+    pref_topo: np.ndarray           # [TP, K] f32
+    pref_own: np.ndarray            # [P, TP] f32 ownership counts
+    pref_match: np.ndarray          # [TP, P] f32
+    pref_w: np.ndarray              # [TP] f32 signed weight (anti < 0)
+    pref_hit0: np.ndarray           # [TP, N] f32 existing match counts per domain
+    sym_dom0: np.ndarray            # [TS, N] f32 existing pods' anti-term domains
+    sym_match: np.ndarray           # [TS, P] f32
+    te_dom0: np.ndarray             # [TE, N] f32 weight-accumulated domains of
+                                    #   existing pods' preferred+hard terms
+    te_match: np.ndarray            # [TE, P] f32
+
+    # volumes (predicates.go:105-269): exclusive-disk conflict columns and
+    # per-family attach-count columns; node state rides the scan carry
+    pod_disk_any: np.ndarray        # [P, D] f32
+    pod_disk_rw: np.ndarray         # [P, D] f32
+    node_disk_any0: np.ndarray      # [N, D] f32
+    node_disk_rw0: np.ndarray       # [N, D] f32
+    pod_ebs: np.ndarray             # [P, VE] f32
+    node_ebs0: np.ndarray           # [N, VE] f32
+    pod_gce: np.ndarray             # [P, VG] f32
+    node_gce0: np.ndarray           # [N, VG] f32
+    max_ebs: np.ndarray             # [] f32
+    max_gce: np.ndarray             # [] f32
 
     n_real_nodes: int = 0
     n_real_pods: int = 0
@@ -200,10 +235,13 @@ class Tensorizer:
             for kv in _labels_of(node).items():
                 label_vocab.id(kv)
         # collect label pairs referenced by pod selectors too (so unmatched
-        # requirements still get a column and fail cleanly)
+        # requirements still get a column and fail cleanly), plus PV
+        # zone/region pairs (VolumeZone folds into the selector tensors)
         for pod in pending:
             for kv in ((pod.spec.node_selector or {}) if pod.spec else {}).items():
                 label_vocab.id(kv)
+            for pair in self._pv_zone_pairs(pod):
+                label_vocab.id(pair)
 
         taint_vocab = Vocab()
         for node in nodes:
@@ -316,7 +354,16 @@ class Tensorizer:
                 if iid is not None:
                     pod_images[p, iid] = 1.0
 
+        # --- volume zone (predicates.go:271-347): a PV's zone/region labels
+        # become required node-label pairs, folded into the nodeSelector
+        # tensors; an unresolvable/unbound PVC adds an unsatisfiable
+        # requirement (sel_count bump with no column) = fail on every node
+        self._fold_volume_zone(pending, sel_required, label_vocab, node_labels,
+                               nodes)
         sel_count = sel_required.sum(axis=1)
+        for p, pod in enumerate(pending):
+            if self._has_broken_pvc(pod):
+                sel_count[p] += 1.0
 
         # --- node affinity ---------------------------------------------------
         (expr_node, term_expr, term_expr_count, pod_term, pod_has_aff,
@@ -327,9 +374,12 @@ class Tensorizer:
         pod_group, pod_in_group, group_counts0, n_groups = self._spread_tensors(
             nodes, existing, pending, node_index, Np, Pp)
 
-        # --- inter-pod (vs existing, static) ---------------------------------
-        forbidden, required_miss = self._interpod_static(
+        # --- inter-pod term tables -------------------------------------------
+        interpod = self._interpod_tensors(
             nodes, existing, pending, node_index, Np, Pp)
+
+        # --- volumes ---------------------------------------------------------
+        volumes = self._volume_tensors(existing, pending, node_index, Np, Pp)
 
         return ClusterTensors(
             node_names=[n.metadata.name for n in nodes],
@@ -351,8 +401,8 @@ class Tensorizer:
             pod_group=pod_group, pod_in_group=pod_in_group,
             group_counts0=group_counts0, n_groups=n_groups,
             image_node_sizes=image_node_sizes, pod_images=pod_images,
-            interpod_forbidden=forbidden, interpod_required_miss=required_miss,
             n_real_nodes=N, n_real_pods=P,
+            **interpod, **volumes,
         )
 
     # -- node affinity --------------------------------------------------------
@@ -503,76 +553,367 @@ class Tensorizer:
 
         return pod_group, pod_in_group, group_counts0, G
 
-    # -- inter-pod static -----------------------------------------------------
+    # -- volume zone / broken PVCs --------------------------------------------
 
-    def _interpod_static(self, nodes, existing, pending, node_index, Np, Pp):
-        """Hard inter-pod (anti-)affinity against existing pods, plus
-        symmetry from existing pods' anti-affinity, as static [P, N] masks
-        (predicates.go:769-947). In-batch interactions are handled by the
-        scan carry (kernel.py) for anti-affinity self-spread terms."""
-        from kubernetes_tpu.scheduler.predicates import (
-            _pod_matches_term, _same_topology,
-        )
-        forbidden = np.zeros((Pp, Np), np.float32)
-        required_miss = np.zeros((Pp, Np), np.float32)
-        placed = [ep for ep in existing if ep.spec and ep.spec.node_name]
+    def _pod_pvs(self, pod: api.Pod):
+        """Resolve the pod's PVC-backed volumes to PVs (None entries for
+        unresolvable/unbound claims)."""
+        args = self.args
+        if args is None or not getattr(args, "pvc_lookup", None):
+            return []
+        ns = pod.metadata.namespace if pod.metadata else ""
+        out = []
+        for v in (pod.spec.volumes or []) if pod.spec else []:
+            if not v.persistent_volume_claim:
+                continue
+            pvc = args.pvc_lookup(ns, v.persistent_volume_claim.claim_name)
+            if pvc is None or not (pvc.spec and pvc.spec.volume_name):
+                out.append(None)
+                continue
+            pv = args.pv_lookup(pvc.spec.volume_name) if args.pv_lookup else None
+            out.append(pv)
+        return out
 
-        def nodes_in_domain_of(ep_node_name: str, topo_key: str) -> List[int]:
-            base = next((n for n in nodes if n.metadata.name == ep_node_name), None)
-            if base is None:
-                return []
-            return [node_index[n.metadata.name] for n in nodes
-                    if _same_topology(base, n, topo_key, self.failure_domains)]
+    def _has_broken_pvc(self, pod: api.Pod) -> bool:
+        return any(pv is None for pv in self._pod_pvs(pod))
 
-        # existing pods' anti-affinity (symmetry)
-        for ep in placed:
-            aff = ep.spec.affinity if ep.spec else None
-            anti = aff.pod_anti_affinity if aff else None
-            for term in ((anti.required_during_scheduling_ignored_during_execution or [])
-                         if anti else []):
-                blocked = None
-                for p, pod in enumerate(pending):
-                    if _pod_matches_term(pod, ep, term):
-                        if blocked is None:
-                            blocked = nodes_in_domain_of(ep.spec.node_name,
-                                                         term.topology_key)
-                        forbidden[p, blocked] = 1.0
+    def _pv_zone_pairs(self, pod: api.Pod):
+        """(key, value) node-label pairs the pod's bound PVs require
+        (VolumeZoneChecker semantics: zone + region labels)."""
+        out = []
+        for pv in self._pod_pvs(pod):
+            if pv is None:
+                continue
+            pv_labels = (pv.metadata.labels or {}) if pv.metadata else {}
+            for key in (api.LABEL_ZONE, api.LABEL_REGION):
+                want = pv_labels.get(key)
+                if want:
+                    out.append((key, want))
+        return out
 
+    def _fold_volume_zone(self, pending, sel_required, label_vocab,
+                          node_labels, nodes):
+        """VolumeZoneChecker as nodeSelector columns: every zone/region label
+        on a bound PV becomes a required node-label pair (the pairs were
+        registered in label_vocab during build's vocab collection, so columns
+        always exist; a pair no node carries is an all-zero column = fail
+        everywhere, exactly the oracle's outcome)."""
         for p, pod in enumerate(pending):
+            for pair in self._pv_zone_pairs(pod):
+                sel_required[p, label_vocab.id(pair)] = 1.0
+
+    # -- inter-pod term tables ------------------------------------------------
+
+    def _interpod_tensors(self, nodes, existing, pending, node_index, Np, Pp):
+        """Compile hard + soft inter-pod (anti-)affinity into term tables
+        (predicates.go:769-947, interpod_affinity.go:86-216). Terms are
+        deduped by (resolved namespaces, selector, topology); ownership is a
+        count matrix so duplicated terms keep their full weight."""
+        from kubernetes_tpu.scheduler.predicates import (
+            _pod_matches_term, _term_namespaces,
+        )
+
+        # topology-key vocabulary: every concrete key used by any term plus
+        # the default failure-domain keys (empty topologyKey = any default,
+        # non_zero.go:87-109)
+        key_vocab = Vocab()
+        for k in self.failure_domains:
+            key_vocab.id(k)
+
+        def topo_keys(term) -> List[int]:
+            if term.topology_key:
+                return [key_vocab.id(term.topology_key)]
+            return [key_vocab.get(k) for k in self.failure_domains]
+
+        def all_terms(pod, kind):
             aff = pod.spec.affinity if pod.spec else None
             if aff is None:
-                continue
-            anti_terms = ((aff.pod_anti_affinity.required_during_scheduling_ignored_during_execution or [])
-                          if aff.pod_anti_affinity else [])
-            for term in anti_terms:
-                for ep in placed:
-                    if _pod_matches_term(ep, pod, term):
-                        for n in nodes_in_domain_of(ep.spec.node_name,
-                                                    term.topology_key):
-                            forbidden[p, n] = 1.0
-            req_terms = ((aff.pod_affinity.required_during_scheduling_ignored_during_execution or [])
-                         if aff.pod_affinity else [])
-            for term in req_terms:
-                ok_nodes = set()
-                any_match = False
-                for ep in placed:
-                    if _pod_matches_term(ep, pod, term):
-                        any_match = True
-                        ok_nodes.update(nodes_in_domain_of(ep.spec.node_name,
-                                                           term.topology_key))
-                if not any_match:
-                    # disregard rule (predicates.go:818-844): self-selecting
-                    # term with no match anywhere may schedule
-                    if _pod_matches_term(pod, pod, term) and not any(
-                            _pod_matches_term(q, pod, term) for q in placed):
-                        continue
-                    required_miss[p, :] = 1.0
-                else:
-                    miss = np.ones(Np, np.float32)
-                    miss[list(ok_nodes)] = 0.0
-                    required_miss[p] = np.maximum(required_miss[p], miss)
+                return []
+            if kind == "aff":
+                src = aff.pod_affinity
+                return (src.required_during_scheduling_ignored_during_execution
+                        or []) if src else []
+            if kind == "anti":
+                src = aff.pod_anti_affinity
+                return (src.required_during_scheduling_ignored_during_execution
+                        or []) if src else []
+            if kind == "pref":
+                out = []
+                if aff.pod_affinity:
+                    for wt in (aff.pod_affinity.
+                               preferred_during_scheduling_ignored_during_execution or []):
+                        if wt.weight and wt.pod_affinity_term:
+                            out.append((wt.pod_affinity_term, float(wt.weight)))
+                if aff.pod_anti_affinity:
+                    for wt in (aff.pod_anti_affinity.
+                               preferred_during_scheduling_ignored_during_execution or []):
+                        if wt.weight and wt.pod_affinity_term:
+                            out.append((wt.pod_affinity_term, -float(wt.weight)))
+                return out
+            raise ValueError(kind)
 
-        return forbidden, required_miss
+        placed = [ep for ep in existing if ep.spec and ep.spec.node_name
+                  and ep.spec.node_name in node_index]
+
+        def term_key(owner, term, weight=None):
+            names = _term_namespaces(owner, term)
+            sel = labelsel.selector_from_label_selector(term.label_selector)
+            return (frozenset(names) if names is not None else "*",
+                    str(sel), term.topology_key or "", weight)
+
+        class TermTable:
+            """Deduped term rows with per-pending-pod match columns."""
+
+            def __init__(self):
+                self.vocab = Vocab()
+                self.rows = []   # (namespaces frozenset|None as '*', selector, kids, weight)
+
+            def add(self, owner, term, weight=None):
+                tk = term_key(owner, term, weight)
+                tid = self.vocab.get(tk)
+                if tid is None:
+                    tid = self.vocab.id(tk)
+                    names = _term_namespaces(owner, term)
+                    sel = labelsel.selector_from_label_selector(term.label_selector)
+                    self.rows.append((names, sel, topo_keys(term), weight))
+                return tid
+
+            def match_matrix(self, pods, P_padded):
+                t = np.zeros((_pad(len(self.rows), 8), P_padded), np.float32)
+                for i, (names, sel, _, _) in enumerate(self.rows):
+                    for p, pod in enumerate(pods):
+                        if names is not None and pod.metadata.namespace not in names:
+                            continue
+                        if sel.matches((pod.metadata.labels or {})):
+                            t[i, p] = 1.0
+                return t
+
+            def topo_matrix(self, K_padded):
+                t = np.zeros((_pad(len(self.rows), 8), K_padded), np.float32)
+                for i, (_, _, kids, _) in enumerate(self.rows):
+                    for kid in kids:
+                        t[i, kid] = 1.0
+                return t
+
+            def matches(self, tid, pod) -> bool:
+                names, sel, _, _ = self.rows[tid]
+                if names is not None and pod.metadata.namespace not in names:
+                    return False
+                return sel.matches((pod.metadata.labels or {}))
+
+            def padded(self):
+                return _pad(len(self.rows), 8)
+
+        req_t, anti_t, pref_t = TermTable(), TermTable(), TermTable()
+        req_own_pairs, anti_own_pairs, pref_own_pairs = [], [], []
+
+        for p, pod in enumerate(pending):
+            for term in all_terms(pod, "aff"):
+                req_own_pairs.append((p, req_t.add(pod, term)))
+            for term in all_terms(pod, "anti"):
+                anti_own_pairs.append((p, anti_t.add(pod, term)))
+            for term, w in all_terms(pod, "pref"):
+                pref_own_pairs.append((p, pref_t.add(pod, term, w)))
+
+        # existing pods' own terms (static; symmetry + reverse score)
+        sym_t = TermTable()       # existing anti (hard): forbids matching pods
+        te_t = TermTable()        # existing preferred + hard-affinity terms
+        sym_entries, te_entries = [], []   # (tid, owner node idx[, weight])
+        hw = float(self.args.hard_pod_affinity_weight
+                   if self.args is not None else 1)
+        for ep in placed:
+            n = node_index[ep.spec.node_name]
+            for term in all_terms(ep, "anti"):
+                sym_entries.append((sym_t.add(ep, term), n))
+            if hw:
+                for term in all_terms(ep, "aff"):
+                    te_entries.append((te_t.add(ep, term, ("hard",)), n, hw))
+            for term, w in all_terms(ep, "pref"):
+                te_entries.append((te_t.add(ep, term, w), n, w))
+
+        # per-key domain ids over nodes (built AFTER all terms registered
+        # their concrete topology keys in key_vocab)
+        K = len(key_vocab)
+        Kp = _pad(K, 8)
+        node_dom_p = np.full((Kp, Np), -1, np.int32)
+        for key, kid in key_vocab.items():
+            dom_vocab = Vocab()
+            for n, node in enumerate(nodes):
+                val = _labels_of(node).get(key)
+                if val:
+                    node_dom_p[kid, n] = dom_vocab.id(val)
+
+        def domain_mask(node_idx: int, kids: List[int]) -> np.ndarray:
+            """Nodes sharing a topology domain with nodes[node_idx] under any
+            of the given keys."""
+            m = np.zeros(Np, np.float32)
+            for kid in kids:
+                row = node_dom_p[kid]
+                d = row[node_idx]
+                if d >= 0:
+                    m = np.maximum(m, (row == d).astype(np.float32))
+            return m
+
+        TR, TA, TP = req_t.padded(), anti_t.padded(), pref_t.padded()
+
+        req_own = np.zeros((Pp, TR), np.float32)
+        for p, t in req_own_pairs:
+            req_own[p, t] += 1.0
+        anti_own = np.zeros((Pp, TA), np.float32)
+        for p, t in anti_own_pairs:
+            anti_own[p, t] += 1.0
+        pref_own = np.zeros((Pp, TP), np.float32)
+        for p, t in pref_own_pairs:
+            pref_own[p, t] += 1.0
+
+        req_match = req_t.match_matrix(pending, Pp)
+        anti_match = anti_t.match_matrix(pending, Pp)
+        pref_match = pref_t.match_matrix(pending, Pp)
+        req_topo = req_t.topo_matrix(Kp)
+        anti_topo = anti_t.topo_matrix(Kp)
+        pref_topo = pref_t.topo_matrix(Kp)
+        pref_w = np.zeros(TP, np.float32)
+        for i, (_, _, _, w) in enumerate(pref_t.rows):
+            pref_w[i] = w
+
+        # --- init from existing pods -----------------------------------------
+        req_hit0 = np.zeros((TR, Np), np.float32)
+        req_nomatch0 = np.ones(TR, bool)
+        anti_hit0 = np.zeros((TA, Np), np.float32)
+        pref_hit0 = np.zeros((TP, Np), np.float32)
+        for ep in placed:
+            n = node_index[ep.spec.node_name]
+            for tid, (names, sel, kids, _) in enumerate(req_t.rows):
+                if req_t.matches(tid, ep):
+                    req_hit0[tid] = np.maximum(req_hit0[tid],
+                                               domain_mask(n, kids))
+                    req_nomatch0[tid] = False
+            for tid, (names, sel, kids, _) in enumerate(anti_t.rows):
+                if anti_t.matches(tid, ep):
+                    anti_hit0[tid] = np.maximum(anti_hit0[tid],
+                                                domain_mask(n, kids))
+            for tid, (names, sel, kids, _) in enumerate(pref_t.rows):
+                if pref_t.matches(tid, ep):
+                    pref_hit0[tid] += domain_mask(n, kids)
+
+        TS, TE = sym_t.padded(), te_t.padded()
+        sym_dom0 = np.zeros((TS, Np), np.float32)
+        for tid, n in sym_entries:
+            kids = sym_t.rows[tid][2]
+            sym_dom0[tid] = np.maximum(sym_dom0[tid], domain_mask(n, kids))
+        sym_match = sym_t.match_matrix(pending, Pp)
+        te_dom0 = np.zeros((TE, Np), np.float32)
+        for tid, n, w in te_entries:
+            kids = te_t.rows[tid][2]
+            te_dom0[tid] += w * domain_mask(n, kids)
+        te_match = te_t.match_matrix(pending, Pp)
+
+        return dict(
+            node_dom=node_dom_p,
+            req_topo=req_topo, req_own=req_own, req_match=req_match,
+            req_hit0=req_hit0, req_nomatch0=req_nomatch0,
+            anti_topo=anti_topo, anti_own=anti_own, anti_match=anti_match,
+            anti_hit0=anti_hit0,
+            pref_topo=pref_topo, pref_own=pref_own, pref_match=pref_match,
+            pref_w=pref_w, pref_hit0=pref_hit0,
+            sym_dom0=sym_dom0, sym_match=sym_match,
+            te_dom0=te_dom0, te_match=te_match,
+        )
+
+    # -- volumes --------------------------------------------------------------
+
+    def _volume_tensors(self, existing, pending, node_index, Np, Pp):
+        """NoDiskConflict + MaxPDVolumeCount operands
+        (predicates.go:64-269). Exclusive-disk columns: GCE PD by name with a
+        separate rw flag (both-read-only shares are legal), EBS by volume id,
+        RBD by (pool, image, monitor) so any shared monitor conflicts."""
+        from kubernetes_tpu.scheduler.predicates import MaxPDVolumeCountChecker
+
+        args = self.args
+        ebs_check = MaxPDVolumeCountChecker(
+            "ebs", 0, getattr(args, "pvc_lookup", None) if args else None,
+            getattr(args, "pv_lookup", None) if args else None)
+        gce_check = MaxPDVolumeCountChecker(
+            "gce-pd", 0, getattr(args, "pvc_lookup", None) if args else None,
+            getattr(args, "pv_lookup", None) if args else None)
+
+        def disk_cols(pod):
+            """[(column key, rw)] exclusive-disk entries for a pod."""
+            out = []
+            for v in (pod.spec.volumes or []) if pod.spec else []:
+                if v.gce_persistent_disk:
+                    out.append((("gce", v.gce_persistent_disk.pd_name),
+                                not v.gce_persistent_disk.read_only))
+                if v.aws_elastic_block_store:
+                    out.append((("ebs", v.aws_elastic_block_store.volume_id),
+                                True))
+                if v.rbd:
+                    for mon in (v.rbd.monitors or []):
+                        out.append((("rbd", v.rbd.pool, v.rbd.image, mon),
+                                    True))
+            return out
+
+        disk_vocab, ebs_vocab, gce_vocab = Vocab(), Vocab(), Vocab()
+        every = list(existing) + list(pending)
+        for pod in every:
+            for key, _ in disk_cols(pod):
+                disk_vocab.id(key)
+            ns = pod.metadata.namespace if pod.metadata else ""
+            for v in (pod.spec.volumes or []) if pod.spec else []:
+                vid = ebs_check._volume_id(v, ns)
+                if vid is not None:
+                    ebs_vocab.id(vid)
+                vid = gce_check._volume_id(v, ns)
+                if vid is not None:
+                    gce_vocab.id(vid)
+
+        D = _pad(len(disk_vocab), 128)
+        VE = _pad(len(ebs_vocab), 128)
+        VG = _pad(len(gce_vocab), 128)
+
+        pod_disk_any = np.zeros((Pp, D), np.float32)
+        pod_disk_rw = np.zeros((Pp, D), np.float32)
+        pod_ebs = np.zeros((Pp, VE), np.float32)
+        pod_gce = np.zeros((Pp, VG), np.float32)
+        node_disk_any0 = np.zeros((Np, D), np.float32)
+        node_disk_rw0 = np.zeros((Np, D), np.float32)
+        node_ebs0 = np.zeros((Np, VE), np.float32)
+        node_gce0 = np.zeros((Np, VG), np.float32)
+
+        def fill(pod, disk_any, disk_rw, ebs_row, gce_row, idx):
+            for key, rw in disk_cols(pod):
+                c = disk_vocab.get(key)
+                disk_any[idx, c] = 1.0
+                if rw:
+                    disk_rw[idx, c] = 1.0
+            ns = pod.metadata.namespace if pod.metadata else ""
+            for v in (pod.spec.volumes or []) if pod.spec else []:
+                vid = ebs_check._volume_id(v, ns)
+                if vid is not None:
+                    ebs_row[idx, ebs_vocab.get(vid)] = 1.0
+                vid = gce_check._volume_id(v, ns)
+                if vid is not None:
+                    gce_row[idx, gce_vocab.get(vid)] = 1.0
+
+        for p, pod in enumerate(pending):
+            fill(pod, pod_disk_any, pod_disk_rw, pod_ebs, pod_gce, p)
+        for ep in existing:
+            n = node_index.get(ep.spec.node_name if ep.spec else "")
+            if n is None:
+                continue
+            fill(ep, node_disk_any0, node_disk_rw0, node_ebs0, node_gce0, n)
+
+        from kubernetes_tpu.scheduler.predicates import (
+            DEFAULT_MAX_EBS_VOLUMES, DEFAULT_MAX_GCE_PD_VOLUMES,
+        )
+        return dict(
+            pod_disk_any=pod_disk_any, pod_disk_rw=pod_disk_rw,
+            node_disk_any0=node_disk_any0, node_disk_rw0=node_disk_rw0,
+            pod_ebs=pod_ebs, node_ebs0=node_ebs0,
+            pod_gce=pod_gce, node_gce0=node_gce0,
+            max_ebs=np.asarray(DEFAULT_MAX_EBS_VOLUMES, np.float32),
+            max_gce=np.asarray(DEFAULT_MAX_GCE_PD_VOLUMES, np.float32),
+        )
 
 
 def _zone_key(node: api.Node) -> str:

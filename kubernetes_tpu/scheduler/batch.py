@@ -21,7 +21,8 @@ from kubernetes_tpu.scheduler.provider import PluginArgs, get_predicates, get_pr
 
 
 DEFAULT_PREDICATE_KEYS = [
-    "NoDiskConflict", "GeneralPredicates", "PodToleratesNodeTaints",
+    "NoDiskConflict", "NoVolumeZoneConflict", "MaxEBSVolumeCount",
+    "MaxGCEPDVolumeCount", "GeneralPredicates", "PodToleratesNodeTaints",
     "CheckNodeMemoryPressure", "MatchInterPodAffinity",
 ]
 DEFAULT_PRIORITY_KEYS = [
@@ -77,7 +78,8 @@ class EmptyLister:
 
 def make_plugin_args(nodes: List[api.Node], pod_lister=None,
                      service_lister=None, controller_lister=None,
-                     replicaset_lister=None) -> PluginArgs:
+                     replicaset_lister=None, pvc_lookup=None,
+                     pv_lookup=None) -> PluginArgs:
     node_map = {n.metadata.name: n for n in nodes}
     empty = EmptyLister()
     return PluginArgs(
@@ -86,6 +88,8 @@ def make_plugin_args(nodes: List[api.Node], pod_lister=None,
         controller_lister=controller_lister or empty,
         replicaset_lister=replicaset_lister or empty,
         node_lookup=node_map.get,
+        pvc_lookup=pvc_lookup,
+        pv_lookup=pv_lookup,
     )
 
 

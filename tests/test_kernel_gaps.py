@@ -1,0 +1,380 @@
+"""Adversarial differential tests for round-1 kernel blind spots.
+
+VERDICT #3: the kernel silently lacked (a) in-batch inter-pod (anti-)affinity
+between *pending* pods, (b) soft InterPodAffinityPriority
+(interpod_affinity.go:86-216), and (c) the volume trio
+(NoDiskConflict/MaxPDVolumeCount/VolumeZone, predicates.go:105-347). These
+tests were written to FAIL against the round-1 kernel before the fix; each
+constructs a cluster where the missing feature changes the binding.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.batch import (
+    ListPodLister, ListServiceLister, make_plugin_args, oracle_batch, tpu_batch,
+)
+
+
+def mk_node(name, cpu="4", mem="32Gi", pods="110", labels=None, taints=None):
+    labels = dict(labels or {})
+    labels.setdefault(api.LABEL_HOSTNAME, name)
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels),
+        spec=api.NodeSpec(taints=taints),
+        status=api.NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": pods},
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+
+def mk_pod(name, ns="default", cpu=None, mem=None, labels=None, node="",
+           affinity=None, volumes=None):
+    requests = {}
+    if cpu:
+        requests["cpu"] = cpu
+    if mem:
+        requests["memory"] = mem
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(
+            node_name=node, affinity=affinity, volumes=volumes,
+            containers=[api.Container(
+                name="c", image="pause",
+                resources=api.ResourceRequirements(requests=requests)
+                if requests else None)]))
+
+
+def anti(match_labels, topology_key=""):
+    return api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+        required_during_scheduling_ignored_during_execution=[
+            api.PodAffinityTerm(
+                label_selector=api.LabelSelector(match_labels=match_labels),
+                topology_key=topology_key)]))
+
+
+def aff(match_labels, topology_key=""):
+    return api.Affinity(pod_affinity=api.PodAffinity(
+        required_during_scheduling_ignored_during_execution=[
+            api.PodAffinityTerm(
+                label_selector=api.LabelSelector(match_labels=match_labels),
+                topology_key=topology_key)]))
+
+
+def pref(match_labels, topology_key="", weight=100, anti_=False):
+    wt = [api.WeightedPodAffinityTerm(
+        weight=weight,
+        pod_affinity_term=api.PodAffinityTerm(
+            label_selector=api.LabelSelector(match_labels=match_labels),
+            topology_key=topology_key))]
+    if anti_:
+        return api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            preferred_during_scheduling_ignored_during_execution=wt))
+    return api.Affinity(pod_affinity=api.PodAffinity(
+        preferred_during_scheduling_ignored_during_execution=wt))
+
+
+def gce_vol(pd, ro=False):
+    return api.Volume(name=pd, gce_persistent_disk=
+                      api.GCEPersistentDiskVolumeSource(pd_name=pd, read_only=ro))
+
+
+def ebs_vol(vid):
+    return api.Volume(name=vid, aws_elastic_block_store=
+                      api.AWSElasticBlockStoreVolumeSource(volume_id=vid))
+
+
+def pvc_vol(claim):
+    return api.Volume(name=claim, persistent_volume_claim=
+                      api.PersistentVolumeClaimVolumeSource(claim_name=claim))
+
+
+def two_args(nodes, existing=(), services=(), pvcs=(), pvs=()):
+    pvc_map = {f"{p.metadata.namespace}/{p.metadata.name}": p for p in pvcs}
+    pv_map = {p.metadata.name: p for p in pvs}
+
+    def mk():
+        return make_plugin_args(
+            nodes, pod_lister=ListPodLister(list(existing)),
+            service_lister=ListServiceLister(services),
+            pvc_lookup=lambda ns, name: pvc_map.get(f"{ns}/{name}"),
+            pv_lookup=pv_map.get)
+    return mk(), mk()
+
+
+def assert_same(nodes, existing, pending, args_oracle, args_tpu, **kw):
+    got_oracle = oracle_batch(nodes, existing, pending, args_oracle, **kw)
+    got_tpu = tpu_batch(nodes, existing, pending, args_tpu)
+    assert got_tpu == got_oracle, (
+        f"kernel disagrees with oracle:\n  oracle: {got_oracle}\n"
+        f"  tpu:    {got_tpu}")
+    return got_oracle
+
+
+class TestInBatchAntiAffinity:
+    def test_zone_anti_affinity_caps_group(self):
+        """3 pods anti-affine on zone, 2 zones: only 2 can place; the third
+        is blocked by *in-batch* commits, which the round-1 kernel ignored."""
+        nodes = [mk_node(f"n{i}", labels={api.LABEL_ZONE: f"z{i % 2}"})
+                 for i in range(4)]
+        pending = [mk_pod(f"p{i}", labels={"app": "db"},
+                          affinity=anti({"app": "db"}, api.LABEL_ZONE))
+                   for i in range(3)]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got.count(None) == 1
+        placed = [g for g in got if g]
+        zones = {g[-1] for g in placed}  # n0/n2 -> z0, n1/n3 -> z1
+        assert len(placed) == 2
+
+    def test_hostname_anti_affinity_spreads(self):
+        nodes = [mk_node(f"n{i}") for i in range(3)]
+        pending = [mk_pod(f"p{i}", labels={"app": "db"},
+                          affinity=anti({"app": "db"}, api.LABEL_HOSTNAME))
+                   for i in range(4)]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        placed = [g for g in got if g]
+        assert len(placed) == 3 and len(set(placed)) == 3
+        assert got.count(None) == 1
+
+    def test_empty_topology_key_uses_failure_domains(self):
+        """topology_key='' means any default failure-domain key
+        (non_zero.go:87-109)."""
+        nodes = [mk_node(f"n{i}", labels={api.LABEL_ZONE: "z0"})
+                 for i in range(3)]
+        pending = [mk_pod(f"p{i}", labels={"app": "db"},
+                          affinity=anti({"app": "db"}))
+                   for i in range(2)]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        # same zone everywhere: second pod has nowhere to go
+        assert got.count(None) == 1
+
+    def test_symmetry_between_pending_pods(self):
+        """Pod A's anti-affinity forbids later pod B that matches A's term
+        (predicates.go:883-921 symmetry, applied in-batch)."""
+        nodes = [mk_node("n0"), mk_node("n1", cpu="8")]
+        pending = [
+            mk_pod("a", labels={"app": "api"}, cpu="100m",
+                   affinity=anti({"app": "web"}, api.LABEL_HOSTNAME)),
+            mk_pod("b", labels={"app": "web"}, cpu="100m"),
+        ]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got[0] is not None and got[1] is not None
+        assert got[0] != got[1]
+
+
+class TestInBatchAffinity:
+    def test_follower_lands_with_leader(self):
+        """B requires app=web on its node; only pending pod A provides it."""
+        nodes = [mk_node(f"n{i}") for i in range(3)]
+        pending = [
+            mk_pod("a", labels={"app": "web"}, cpu="100m"),
+            mk_pod("b", labels={"app": "api"}, cpu="100m",
+                   affinity=aff({"app": "web"}, api.LABEL_HOSTNAME)),
+        ]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got[1] == got[0]
+
+    def test_self_affine_group_stays_in_one_zone(self):
+        """First pod of a self-selecting group schedules via the disregard
+        rule (predicates.go:818-844); the rest must join its domain."""
+        nodes = [mk_node(f"n{i}", labels={api.LABEL_ZONE: f"z{i % 2}"},
+                         cpu=("8" if i == 1 else "4"))
+                 for i in range(4)]
+        pending = [mk_pod(f"p{i}", labels={"app": "web"}, cpu="1",
+                          affinity=aff({"app": "web"}, api.LABEL_ZONE))
+                   for i in range(3)]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert None not in got
+        zones = {int(g[1]) % 2 for g in got}
+        assert len(zones) == 1
+
+    def test_affinity_to_existing_pod_still_works(self):
+        nodes = [mk_node("n0"), mk_node("n1")]
+        existing = [mk_pod("e", labels={"app": "web"}, node="n1")]
+        pending = [mk_pod("p", labels={"app": "api"},
+                          affinity=aff({"app": "web"}, api.LABEL_HOSTNAME))]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["n1"]
+
+
+class TestSoftInterPodAffinity:
+    def test_preferred_affinity_to_existing_pod(self):
+        """Weighted preference pulls the pod toward the cache's zone even
+        when least-requested prefers elsewhere."""
+        nodes = [mk_node("n0", labels={api.LABEL_ZONE: "z0"}),
+                 mk_node("n1", labels={api.LABEL_ZONE: "z1"}, cpu="8")]
+        existing = [mk_pod("cache", labels={"app": "cache"}, node="n0",
+                           cpu="500m")]
+        pending = [mk_pod("p", labels={"app": "api"}, cpu="100m",
+                          affinity=pref({"app": "cache"}, api.LABEL_ZONE))]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["n0"]
+
+    def test_preferred_anti_affinity_pushes_away(self):
+        nodes = [mk_node("n0", labels={api.LABEL_ZONE: "z0"}, cpu="8"),
+                 mk_node("n1", labels={api.LABEL_ZONE: "z1"})]
+        existing = [mk_pod("noisy", labels={"app": "noisy"}, node="n0",
+                           cpu="100m")]
+        pending = [mk_pod("p", labels={"app": "api"}, cpu="100m",
+                          affinity=pref({"app": "noisy"}, api.LABEL_ZONE,
+                                        anti_=True))]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["n1"]
+
+    def test_reverse_preference_from_existing_pod(self):
+        """Existing pod's preferred affinity about the incoming pod counts
+        too (interpod_affinity.go reverse direction)."""
+        nodes = [mk_node("n0", labels={api.LABEL_ZONE: "z0"}),
+                 mk_node("n1", labels={api.LABEL_ZONE: "z1"}, cpu="8")]
+        existing = [mk_pod("waiting", labels={"app": "waiting"}, node="n0",
+                           cpu="500m",
+                           affinity=pref({"app": "friend"}, api.LABEL_ZONE))]
+        pending = [mk_pod("p", labels={"app": "friend"}, cpu="100m")]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["n0"]
+
+    def test_hard_affinity_symmetric_weight(self):
+        """Existing pod's *hard* affinity terms matching the incoming pod add
+        hardPodAffinityWeight (interpod_affinity.go:120-140)."""
+        nodes = [mk_node("n0", labels={api.LABEL_ZONE: "z0"}),
+                 mk_node("n1", labels={api.LABEL_ZONE: "z1"}, cpu="8")]
+        existing = [mk_pod("e", labels={"app": "leader"}, node="n0", cpu="500m",
+                           affinity=aff({"app": "member"}, api.LABEL_ZONE))]
+        pending = [mk_pod("p", labels={"app": "member"}, cpu="100m")]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["n0"]
+
+    def test_in_batch_soft_affinity(self):
+        """B prefers A's zone; A is also pending (in-batch commit feeds the
+        score)."""
+        nodes = [mk_node("n0", labels={api.LABEL_ZONE: "z0"}, cpu="3"),
+                 mk_node("n1", labels={api.LABEL_ZONE: "z1"}, cpu="8")]
+        pending = [
+            mk_pod("a", labels={"app": "cache"}, cpu="2800m"),  # -> n1 (fits)
+            mk_pod("b", labels={"app": "api"}, cpu="100m",
+                   affinity=pref({"app": "cache"}, api.LABEL_ZONE,
+                                 weight=100)),
+        ]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got[1][-1] == got[0][-1]
+
+
+class TestVolumePredicates:
+    def test_gce_pd_conflict_with_existing(self):
+        nodes = [mk_node("n0", cpu="8"), mk_node("n1")]
+        existing = [mk_pod("e", node="n0", cpu="100m",
+                           volumes=[gce_vol("data")])]
+        pending = [mk_pod("p", cpu="100m", volumes=[gce_vol("data")])]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["n1"]
+
+    def test_gce_pd_both_read_only_ok(self):
+        nodes = [mk_node("n0", cpu="8"), mk_node("n1")]
+        existing = [mk_pod("e", node="n0", cpu="100m",
+                           volumes=[gce_vol("data", ro=True)])]
+        pending = [mk_pod("p", cpu="100m", volumes=[gce_vol("data", ro=True)])]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got == ["n0"]
+
+    def test_in_batch_ebs_conflict(self):
+        """Two pending pods share an EBS volume: the second must avoid the
+        first's node."""
+        nodes = [mk_node("n0"), mk_node("n1")]
+        pending = [mk_pod("p0", cpu="100m", volumes=[ebs_vol("vol-1")]),
+                   mk_pod("p1", cpu="100m", volumes=[ebs_vol("vol-1")])]
+        a, b = two_args(nodes)
+        got = assert_same(nodes, [], pending, a, b)
+        assert None not in got
+        assert got[0] != got[1]
+
+    def test_max_ebs_volume_count(self):
+        """Node at the 39-volume EBS attach limit rejects a pod with a new
+        volume but accepts one reusing an attached volume."""
+        nodes = [mk_node("full", cpu="64"), mk_node("empty")]
+        existing = []
+        vid = 0
+        for i in range(4):
+            count = 10 if i < 3 else 9
+            existing.append(mk_pod(
+                f"e{i}", node="full", cpu="100m",
+                volumes=[ebs_vol(f"vol-{vid + j}") for j in range(count)]))
+            vid += count
+        assert vid == 39
+        pending = [mk_pod("new", cpu="100m", volumes=[ebs_vol("vol-new")]),
+                   mk_pod("reuse", cpu="100m", volumes=[ebs_vol("vol-0")])]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got[0] == "empty"
+        assert got[1] == "full"  # least-requested prefers big idle node
+
+    def test_volume_zone_conflict(self):
+        pvs = [api.PersistentVolume(
+            metadata=api.ObjectMeta(
+                name="pv-z0", labels={api.LABEL_ZONE: "z0"}),
+            spec=api.PersistentVolumeSpec(
+                gce_persistent_disk=api.GCEPersistentDiskVolumeSource(
+                    pd_name="disk0")))]
+        pvcs = [api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="claim0", namespace="default"),
+            spec=api.PersistentVolumeClaimSpec(volume_name="pv-z0"))]
+        nodes = [mk_node("n0", labels={api.LABEL_ZONE: "z1"}, cpu="8"),
+                 mk_node("n1", labels={api.LABEL_ZONE: "z0"})]
+        pending = [mk_pod("p", cpu="100m", volumes=[pvc_vol("claim0")])]
+        a, b = two_args(nodes, pvcs=pvcs, pvs=pvs)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got == ["n1"]
+
+    def test_unbound_pvc_unschedulable(self):
+        pvcs = [api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="pending-claim", namespace="default"),
+            spec=api.PersistentVolumeClaimSpec())]
+        nodes = [mk_node("n0")]
+        pending = [mk_pod("p", cpu="100m", volumes=[pvc_vol("pending-claim")])]
+        a, b = two_args(nodes, pvcs=pvcs)
+        got = assert_same(nodes, [], pending, a, b)
+        assert got == [None]
+
+
+class TestMixedStress:
+    def test_random_cluster_with_all_features(self):
+        import random
+        rng = random.Random(7)
+        nodes = [mk_node(f"n{i:02d}",
+                         labels={api.LABEL_ZONE: f"z{i % 3}"},
+                         cpu=rng.choice(["2", "4", "8"]))
+                 for i in range(12)]
+        apps = ["web", "db", "cache"]
+        pending = []
+        for i in range(30):
+            app = rng.choice(apps)
+            affinity = None
+            volumes = None
+            roll = rng.random()
+            if roll < 0.2:
+                affinity = anti({"app": app}, api.LABEL_ZONE)
+            elif roll < 0.35:
+                affinity = aff({"app": rng.choice(apps)}, api.LABEL_ZONE)
+            elif roll < 0.5:
+                affinity = pref({"app": rng.choice(apps)}, api.LABEL_ZONE,
+                                weight=rng.choice([10, 50]),
+                                anti_=rng.random() < 0.5)
+            elif roll < 0.6:
+                volumes = [ebs_vol(f"vol-{rng.randrange(6)}")]
+            pending.append(mk_pod(f"p{i:02d}", labels={"app": app},
+                                  cpu=rng.choice(["100m", "500m"]),
+                                  affinity=affinity, volumes=volumes))
+        a, b = two_args(nodes)
+        assert_same(nodes, [], pending, a, b)
