@@ -183,7 +183,7 @@ def main():
         fail_json("backend_init", e)
         return
 
-    from kubernetes_tpu.ops.kernel import Weights, _schedule_jit
+    from kubernetes_tpu.ops.kernel import Weights, _schedule_jit, features_of
     from kubernetes_tpu.ops.tensorize import Tensorizer
     from kubernetes_tpu.scheduler.batch import ListServiceLister, make_plugin_args
 
@@ -210,9 +210,10 @@ def main():
     t_upload = time.perf_counter()
 
     weights = Weights()
+    feats = features_of(ct)
     try:
         def compile_and_run():
-            out = _schedule_jit(arrays, ct.n_zones, weights)
+            out = _schedule_jit(arrays, ct.n_zones, weights, feats)
             jax.block_until_ready(out)
             return out
         out = run_with_timeout(compile_and_run, 900, "kernel compile")
@@ -222,7 +223,7 @@ def main():
         runs = []
         for _ in range(3):
             t0 = time.perf_counter()
-            out = _schedule_jit(arrays, ct.n_zones, weights)
+            out = _schedule_jit(arrays, ct.n_zones, weights, feats)
             jax.block_until_ready(out)
             runs.append(time.perf_counter() - t0)
     except Exception as e:

@@ -4,34 +4,61 @@ Two stages, mirroring the decomposition in SURVEY §7:
 
 Stage A (assignment-independent, MXU-batched):
   - predicate masks: node selector / NodeAffinity / taints / memory-pressure /
-    host pinning / inter-pod static — each one matmul + compare over the
-    vocab-encoded tensors (predicates.go:416-1002 vectorized)
+    host pinning — each one matmul + compare over the vocab-encoded tensors
+    (predicates.go:416-1002 vectorized)
+  - static inter-pod symmetry with *existing* pods' anti-affinity terms rides
+    the per-step matvec against sym_dom0 (predicates.go:883-921)
   - score ingredients that don't depend on commits: preferred-affinity weight
     counts, intolerable-PreferNoSchedule counts, image-locality buckets
 
 Stage B (lax.scan over pods in FIFO order):
   replicates the reference's one-pod-at-a-time semantics exactly — each step
-  sees capacity/ports/spread state that includes every prior in-batch commit
-  (the on-device analogue of AssumePod, cache.go:101). Priorities normalize
-  over the *feasible* node set per pod (the reference prioritizes only
-  filtered nodes, generic_scheduler.go:94-107), so normalizations are
-  computed in-step against the dynamic mask. Ties break round-robin over the
-  canonical node order with a carried counter (selectHost,
-  generic_scheduler.go:116-133).
+  sees capacity/ports/spread/affinity/volume state that includes every prior
+  in-batch commit (the on-device analogue of AssumePod, cache.go:101):
+
+  - hard inter-pod affinity (predicates.go:769-844): per-term domain-hit rows
+    req_hit[TR,N] carried and max-updated when a committed pod matches the
+    term; the disregard rule (self-selecting term, no match anywhere) uses a
+    carried req_nomatch[TR] flag.
+  - hard anti-affinity + symmetry (predicates.go:858-921): anti_hit[TA,N]
+    forbids term owners; sym_dyn[TA,N] forbids later pods matching an
+    already-committed owner's term (in-batch symmetry); sym_dom0[TS,N] covers
+    existing pods' terms statically.
+  - soft InterPodAffinityPriority (interpod_affinity.go:86-216): forward
+    weighted match counts via carried pref_hit[TP,N]; reverse direction from
+    existing pods via te_dom0[TE,N] (weights pre-folded, incl. the
+    hardPodAffinityWeight for hard terms) and from in-batch commits via
+    te_dyn[TP,N] / hw_dyn[TR,N]; min-max normalized over the feasible set
+    with the window clamped to include 0 (`var maxCount int` starts at 0).
+  - volumes (predicates.go:64-269): NoDiskConflict via carried per-node
+    exclusive-disk occupancy (both-read-only GCE shares legal);
+    MaxPDVolumeCount via carried EBS/GCE attach-column occupancy vs
+    max_ebs/max_gce (union counts, pass when the pod brings no volumes).
+
+  Priorities normalize over the *feasible* node set per pod (the reference
+  prioritizes only filtered nodes, generic_scheduler.go:94-107). Ties break
+  round-robin over the canonical node order with a carried counter
+  (selectHost, generic_scheduler.go:116-133).
+
+Feature flags (Features) are computed host-side from the batch and are static
+jit arguments: a batch with no inter-pod terms / volumes / host-ports traces
+none of those carries, so the common case stays a lean
+capacity+spread+affinity scan (no [N,D]-sized HBM traffic per step).
 
 Integer-truncation points match the Go code: calculateScore's
 ((cap-req)*10)/cap, the (cpu+mem)/2 average, int(fScore) everywhere
 (priorities.go:33-43 etc.) — implemented as floor on non-negative f32.
 
 All shapes are static per batch (padded); the jit cache is keyed by padded
-(P, N, vocab) sizes, so repeated batches of similar shape reuse the compile.
+(P, N, vocab) sizes + Features, so repeated batches of similar shape reuse
+the compile.
 """
 
 from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +69,7 @@ from kubernetes_tpu.ops.tensorize import ClusterTensors
 # numpy scalar, not jnp: module import must stay device-free (backend init
 # at import time would grab the chip even for CPU-only test runs)
 NEG = np.float32(-1e9)
+POS = np.float32(1e9)
 
 
 @dataclass(frozen=True)
@@ -54,8 +82,41 @@ class Weights:
     spread: int = 1
     node_affinity: int = 1
     taint_toleration: int = 1
+    interpod_affinity: int = 1
     image_locality: int = 0
     equal: int = 0
+
+
+class Features(NamedTuple):
+    """Which optional carries this batch needs (static jit key)."""
+
+    req: bool = False        # pending pods own hard affinity terms
+    anti: bool = False       # pending pods own hard anti-affinity terms
+    sym: bool = False        # existing pods own anti terms (static symmetry)
+    pref: bool = False       # pending pods own preferred terms
+    te: bool = False         # existing pods' terms carry reverse score
+    hw: bool = False         # reverse hard-affinity weight > 0 (needs req)
+    disk: bool = False       # exclusive-disk conflict columns in play
+    ebs: bool = False        # EBS attach-count columns in play
+    gce: bool = False        # GCE-PD attach-count columns in play
+    ports: bool = False      # host ports requested by pending pods
+
+
+def features_of(ct: ClusterTensors) -> Features:
+    """Host-side batch inspection -> static trace flags."""
+    has_req = bool(ct.req_own.any())
+    return Features(
+        req=has_req,
+        anti=bool(ct.anti_own.any()),
+        sym=bool(ct.sym_dom0.any()),
+        pref=bool(ct.pref_own.any()),
+        te=bool(ct.te_dom0.any()),
+        hw=has_req and float(ct.hard_weight) > 0,
+        disk=bool(ct.pod_disk_any.any()),
+        ebs=bool(ct.pod_ebs.any()),
+        gce=bool(ct.pod_gce.any()),
+        ports=bool(ct.pod_ports.any()),
+    )
 
 
 # --- stage A -----------------------------------------------------------------
@@ -63,7 +124,6 @@ class Weights:
 def static_pass(t: dict) -> dict:
     """All [P, N] mask/score ingredients that don't depend on assignment."""
     node_labels = t["node_labels"]          # [N, L]
-    P = t["req"].shape[0]
     N = t["alloc"].shape[0]
 
     sel_ok = (t["sel_required"] @ node_labels.T) >= t["sel_count"][:, None]
@@ -82,8 +142,7 @@ def static_pass(t: dict) -> dict:
     host_ok = (host == -1) | (host == idx[None, :])
 
     static_mask = (
-        t["node_valid"][None, :] & sel_ok & aff_ok & taint_ok & mem_ok & host_ok
-        & (t["interpod_forbidden"] == 0.0) & (t["interpod_required_miss"] == 0.0))
+        t["node_valid"][None, :] & sel_ok & aff_ok & taint_ok & mem_ok & host_ok)
 
     pref_count = (t["pod_pref_term"] * t["pref_weight"][None, :]) @ t["pref_term_node"]
     taint_pref_count = (1.0 - t["tol_prefer"]) @ t["taints_prefer"].T
@@ -105,13 +164,17 @@ def _masked_max(x, mask):
     return jnp.max(jnp.where(mask, x, NEG))
 
 
-def greedy_commit(t: dict, s: dict, w: Weights):
+def _masked_min(x, mask):
+    return jnp.min(jnp.where(mask, x, POS))
+
+
+def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
     """lax.scan over pods; returns assignments [P] i32 (-1 = unschedulable)."""
+    assert not feats.hw or feats.req, "hw carry requires the req term table"
     alloc = t["alloc"]                      # [N, 4]
     N = alloc.shape[0]
     zone_id = t["zone_id"]                  # [N]
     Z = int(t["n_zones"]) if isinstance(t["n_zones"], int) else t["n_zones"]
-    G = t["group_counts0"].shape[1]
     idx_n = jnp.arange(N, dtype=jnp.int32)
 
     zero_req = jnp.all(t["req"][:, :3] == 0.0, axis=1)  # pods axis excluded
@@ -122,33 +185,119 @@ def greedy_commit(t: dict, s: dict, w: Weights):
     zone_onehot = ((zone_id[:, None] == jnp.arange(Z)[None, :])
                    & (zone_id >= 0)[:, None]).astype(jnp.float32)  # [N, Z]
 
+    # static interpod operands captured by the step closure
+    node_dom = t["node_dom"]                # [K, N] i32
+    sym_dom0 = t["sym_dom0"]                # [TS, N]
+    te_dom0 = t["te_dom0"]                  # [TE, N]
+    pref_w = t["pref_w"]                    # [TP]
+    hard_w = t["hard_weight"]               # [] f32
+
+    use_dm = feats.req or feats.anti or feats.pref
+    use_ip_score = feats.pref or feats.te or feats.hw
+
     xs = {
-        "req": t["req"], "nz": t["nonzero_req"], "ports": t["pod_ports"],
+        "req": t["req"], "nz": t["nonzero_req"],
         "mask": s["mask"], "pref": s["pref_count"],
         "taint_pref": s["taint_pref_count"], "image": s["image_score"],
         "group": t["pod_group"], "in_group": t["pod_in_group"],
         "valid": t["pod_valid"], "zero_req": zero_req,
     }
+    if feats.ports:
+        xs["ports"] = t["pod_ports"]
+    if feats.req:
+        xs["req_own"] = t["req_own"]                  # [P, TR]
+        xs["req_matchT"] = t["req_match"].T           # [P, TR]
+    if feats.anti:
+        xs["anti_own"] = t["anti_own"]                # [P, TA]
+        xs["anti_matchT"] = t["anti_match"].T         # [P, TA]
+    if feats.pref:
+        xs["pref_own"] = t["pref_own"]                # [P, TP]
+        xs["pref_matchT"] = t["pref_match"].T         # [P, TP]
+    if feats.sym:
+        xs["sym_matchT"] = t["sym_match"].T           # [P, TS]
+    if feats.te:
+        xs["te_matchT"] = t["te_match"].T             # [P, TE]
+    if feats.disk:
+        xs["disk_any"] = t["pod_disk_any"]            # [P, D]
+        xs["disk_rw"] = t["pod_disk_rw"]              # [P, D]
+    if feats.ebs:
+        xs["ebs"] = t["pod_ebs"]                      # [P, VE]
+    if feats.gce:
+        xs["gce"] = t["pod_gce"]                      # [P, VG]
 
     init = {
         "used": t["used0"], "used_nz": t["used0_nonzero"],
-        "ports": t["node_ports0"], "gcounts": t["group_counts0"],
-        "rr": jnp.int32(0),
+        "gcounts": t["group_counts0"], "rr": jnp.int32(0),
     }
+    if feats.ports:
+        init["ports"] = t["node_ports0"]
+    if feats.req:
+        init["req_hit"] = t["req_hit0"]               # [TR, N]
+        init["req_nomatch"] = t["req_nomatch0"]       # [TR] bool
+    if feats.hw:
+        init["hw_dyn"] = jnp.zeros_like(t["req_hit0"])
+    if feats.anti:
+        init["anti_hit"] = t["anti_hit0"]             # [TA, N]
+        init["sym_dyn"] = jnp.zeros_like(t["anti_hit0"])
+    if feats.pref:
+        init["pref_hit"] = t["pref_hit0"]             # [TP, N]
+        init["te_dyn"] = jnp.zeros_like(t["pref_hit0"])
+    if feats.disk:
+        init["disk_any"] = t["node_disk_any0"]        # [N, D]
+        init["disk_rw"] = t["node_disk_rw0"]          # [N, D]
+    if feats.ebs:
+        init["ebs_occ"] = t["node_ebs0"]              # [N, VE]
+    if feats.gce:
+        init["gce_occ"] = t["node_gce0"]              # [N, VG]
 
     wf = {k: jnp.float32(v) for k, v in w.__dict__.items()}
 
     def step(carry, x):
-        used, used_nz, ports, gcounts, rr = (
-            carry["used"], carry["used_nz"], carry["ports"],
-            carry["gcounts"], carry["rr"])
+        used, used_nz, gcounts, rr = (
+            carry["used"], carry["used_nz"], carry["gcounts"], carry["rr"])
 
         # --- dynamic predicates (PodFitsResources + ports) -------------------
         pod_count_ok = used[:, 3] + 1.0 <= alloc[:, 3]
         res_fit = jnp.all(used[:, :3] + x["req"][None, :3] <= alloc[:, :3], axis=1)
         res_ok = x["zero_req"] | res_fit        # zero-request: count-only
-        port_clash = (ports @ x["ports"]) > 0.0
-        mask = x["mask"] & pod_count_ok & res_ok & (~port_clash)
+        mask = x["mask"] & pod_count_ok & res_ok
+        if feats.ports:
+            mask = mask & ((carry["ports"] @ x["ports"]) == 0.0)
+
+        # --- volumes (predicates.go:64-269) ----------------------------------
+        if feats.disk:
+            # conflict unless every shared column is read-only on both sides:
+            # pod-rw vs node-any plus pod-any vs node-rw covers "not both ro"
+            clash = (carry["disk_any"] @ x["disk_rw"]
+                     + carry["disk_rw"] @ x["disk_any"])
+            mask = mask & (clash == 0.0)
+        if feats.ebs:
+            pod_cnt = jnp.sum(x["ebs"])
+            union = (jnp.sum(carry["ebs_occ"], axis=1) + pod_cnt
+                     - carry["ebs_occ"] @ x["ebs"])
+            mask = mask & ((pod_cnt == 0.0) | (union <= t["max_ebs"]))
+        if feats.gce:
+            pod_cnt = jnp.sum(x["gce"])
+            union = (jnp.sum(carry["gce_occ"], axis=1) + pod_cnt
+                     - carry["gce_occ"] @ x["gce"])
+            mask = mask & ((pod_cnt == 0.0) | (union <= t["max_gce"]))
+
+        # --- hard inter-pod affinity (predicates.go:769-844) -----------------
+        if feats.req:
+            # per-term ok: a matching pod in this node's domain, or the
+            # disregard rule (self-selecting term, no match anywhere)
+            disregard = (x["req_matchT"] > 0) & carry["req_nomatch"]
+            term_ok = (carry["req_hit"] > 0) | disregard[:, None]
+            viol = x["req_own"] @ (1.0 - term_ok.astype(jnp.float32))
+            mask = mask & (viol == 0.0)
+        # --- anti-affinity + symmetry (predicates.go:858-921) ----------------
+        if feats.anti:
+            v = (x["anti_own"] @ carry["anti_hit"]
+                 + x["anti_matchT"] @ carry["sym_dyn"])
+            mask = mask & (v == 0.0)
+        if feats.sym:
+            mask = mask & ((x["sym_matchT"] @ sym_dom0) == 0.0)
+
         feasible = jnp.any(mask) & x["valid"]
 
         # --- dynamic scores --------------------------------------------------
@@ -193,9 +342,30 @@ def greedy_commit(t: dict, s: dict, w: Weights):
         taint_sc = jnp.where(max_tp > 0.0,
                              jnp.floor((1.0 - x["taint_pref"] / max_tp) * 10.0), 10.0)
 
+        # soft inter-pod affinity (interpod_affinity.go:86-216): forward
+        # weighted matches + reverse preferences of placed pods about us,
+        # min-max normalized over the feasible set with 0 in the window
+        if use_ip_score:
+            c = jnp.zeros((N,), jnp.float32)
+            if feats.pref:
+                c = c + (x["pref_own"] * pref_w) @ carry["pref_hit"]
+                c = c + x["pref_matchT"] @ carry["te_dyn"]
+            if feats.te:
+                c = c + x["te_matchT"] @ te_dom0
+            if feats.hw:
+                c = c + hard_w * (x["req_matchT"] @ carry["hw_dyn"])
+            ip_max = jnp.maximum(_masked_max(c, mask), 0.0)
+            ip_min = jnp.minimum(_masked_min(c, mask), 0.0)
+            ip_rng = ip_max - ip_min
+            interpod = jnp.where(ip_rng > 0.0,
+                                 jnp.floor(10.0 * (c - ip_min) / ip_rng), 0.0)
+        else:
+            interpod = 0.0
+
         score = (wf["least_requested"] * least + wf["balanced"] * balanced
                  + wf["spread"] * spread + wf["node_affinity"] * node_aff
                  + wf["taint_toleration"] * taint_sc
+                 + wf["interpod_affinity"] * interpod
                  + wf["image_locality"] * x["image"] + wf["equal"] * 1.0)
 
         # --- selectHost: max + round-robin tie-break -------------------------
@@ -213,27 +383,70 @@ def greedy_commit(t: dict, s: dict, w: Weights):
         onehot = ((idx_n == chosen) & commit).astype(jnp.float32)
         used = used + onehot[:, None] * x["req"][None, :]
         used_nz = used_nz + onehot[:, None] * x["nz"][None, :]
-        ports = jnp.maximum(ports, onehot[:, None] * x["ports"][None, :])
         gcounts = gcounts + onehot[:, None] * x["in_group"][None, :]
         rr = rr + commit.astype(jnp.int32)
 
-        return ({"used": used, "used_nz": used_nz, "ports": ports,
-                 "gcounts": gcounts, "rr": rr}, chosen)
+        out = {"used": used, "used_nz": used_nz, "gcounts": gcounts, "rr": rr}
+        if feats.ports:
+            out["ports"] = jnp.maximum(
+                carry["ports"], onehot[:, None] * x["ports"][None, :])
+
+        if use_dm:
+            # nodes sharing a topology domain with the chosen node, per key
+            # (zeroed when nothing committed, so all updates no-op)
+            safe = jnp.maximum(chosen, 0)
+            dom_c = node_dom[:, safe]                            # [K]
+            eq = ((node_dom == dom_c[:, None]) & (node_dom >= 0)
+                  ).astype(jnp.float32) * commit.astype(jnp.float32)  # [K, N]
+        if feats.req:
+            dm = ((t["req_topo"] @ eq) > 0).astype(jnp.float32)  # [TR, N]
+            qmatch = x["req_matchT"]
+            out["req_hit"] = jnp.maximum(carry["req_hit"],
+                                         qmatch[:, None] * dm)
+            out["req_nomatch"] = carry["req_nomatch"] & ~((qmatch > 0) & commit)
+            if feats.hw:
+                out["hw_dyn"] = carry["hw_dyn"] + x["req_own"][:, None] * dm
+        if feats.anti:
+            dm = ((t["anti_topo"] @ eq) > 0).astype(jnp.float32)
+            out["anti_hit"] = jnp.maximum(carry["anti_hit"],
+                                          x["anti_matchT"][:, None] * dm)
+            out["sym_dyn"] = jnp.maximum(
+                carry["sym_dyn"],
+                (x["anti_own"] > 0).astype(jnp.float32)[:, None] * dm)
+        if feats.pref:
+            dm = ((t["pref_topo"] @ eq) > 0).astype(jnp.float32)
+            out["pref_hit"] = carry["pref_hit"] + x["pref_matchT"][:, None] * dm
+            out["te_dyn"] = (carry["te_dyn"]
+                             + (x["pref_own"] * pref_w)[:, None] * dm)
+        if feats.disk:
+            out["disk_any"] = jnp.maximum(
+                carry["disk_any"], onehot[:, None] * x["disk_any"][None, :])
+            out["disk_rw"] = jnp.maximum(
+                carry["disk_rw"], onehot[:, None] * x["disk_rw"][None, :])
+        if feats.ebs:
+            out["ebs_occ"] = jnp.maximum(
+                carry["ebs_occ"], onehot[:, None] * x["ebs"][None, :])
+        if feats.gce:
+            out["gce_occ"] = jnp.maximum(
+                carry["gce_occ"], onehot[:, None] * x["gce"][None, :])
+
+        return out, chosen
 
     # unroll amortizes per-iteration loop overhead; the body is tiny
-    # (elementwise over N + one [N, PT] matvec) so overhead dominates
+    # (elementwise over N + a few [T, N] matvecs) so overhead dominates
     _, assignments = jax.lax.scan(step, init, xs, unroll=8)
     return assignments
 
 
 # --- public API ---------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("n_zones", "weights"))
-def _schedule_jit(tensors: dict, n_zones: int, weights: Weights):
+@functools.partial(jax.jit, static_argnames=("n_zones", "weights", "feats"))
+def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
+                  feats: Features):
     t = dict(tensors)
     t["n_zones"] = n_zones
     s = static_pass(t)
-    return greedy_commit(t, s, weights)
+    return greedy_commit(t, s, weights, feats)
 
 
 def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
@@ -241,10 +454,11 @@ def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
     """Schedule a tensorized batch; returns node name (or None) per pending
     pod, FIFO order."""
     weights = weights or Weights()
+    feats = features_of(ct)
     arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
     if device is not None:
         arrays = jax.device_put(arrays, device)
-    out = np.asarray(_schedule_jit(arrays, ct.n_zones, weights))
+    out = np.asarray(_schedule_jit(arrays, ct.n_zones, weights, feats))
     result: List[Optional[str]] = []
     for i in range(ct.n_real_pods):
         n = int(out[i])

@@ -153,6 +153,8 @@ class ClusterTensors:
     te_dom0: np.ndarray             # [TE, N] f32 weight-accumulated domains of
                                     #   existing pods' preferred+hard terms
     te_match: np.ndarray            # [TE, P] f32
+    hard_weight: np.ndarray         # [] f32 hardPodAffinityWeight (in-batch
+                                    #   reverse-hard score, interpod_affinity.go:120-140)
 
     # volumes (predicates.go:105-269): exclusive-disk conflict columns and
     # per-family attach-count columns; node state rides the scan carry
@@ -559,7 +561,10 @@ class Tensorizer:
         """Resolve the pod's PVC-backed volumes to PVs (None entries for
         unresolvable/unbound claims)."""
         args = self.args
-        if args is None or not getattr(args, "pvc_lookup", None):
+        # both lookups required, matching the provider's NoVolumeZoneConflict
+        # gate (a partial informer set must not mark PVC pods unschedulable)
+        if args is None or not getattr(args, "pvc_lookup", None) \
+                or not getattr(args, "pv_lookup", None):
             return []
         ns = pod.metadata.namespace if pod.metadata else ""
         out = []
@@ -570,8 +575,7 @@ class Tensorizer:
             if pvc is None or not (pvc.spec and pvc.spec.volume_name):
                 out.append(None)
                 continue
-            pv = args.pv_lookup(pvc.spec.volume_name) if args.pv_lookup else None
-            out.append(pv)
+            out.append(args.pv_lookup(pvc.spec.volume_name))
         return out
 
     def _has_broken_pvc(self, pod: api.Pod) -> bool:
@@ -818,6 +822,7 @@ class Tensorizer:
             pref_w=pref_w, pref_hit0=pref_hit0,
             sym_dom0=sym_dom0, sym_match=sym_match,
             te_dom0=te_dom0, te_match=te_match,
+            hard_weight=np.asarray(hw, np.float32),
         )
 
     # -- volumes --------------------------------------------------------------

@@ -313,12 +313,36 @@ class TestVolumePredicates:
                 volumes=[ebs_vol(f"vol-{vid + j}") for j in range(count)]))
             vid += count
         assert vid == 39
+        # "reuse" needs 8 cores so "empty" (4 cores) can't take it, and on
+        # "full" NoDiskConflict forbids sharing an attached EBS volume: the
+        # attach-count reuse exemption never helps EBS, so it goes nowhere
         pending = [mk_pod("new", cpu="100m", volumes=[ebs_vol("vol-new")]),
-                   mk_pod("reuse", cpu="100m", volumes=[ebs_vol("vol-0")])]
+                   mk_pod("reuse", cpu="8", volumes=[ebs_vol("vol-0")])]
         a, b = two_args(nodes, existing)
         got = assert_same(nodes, existing, pending, a, b)
         assert got[0] == "empty"
-        assert got[1] == "full"  # least-requested prefers big idle node
+        assert got[1] is None
+
+    def test_max_gce_volume_reuse_read_only(self):
+        """Node at the 16-volume GCE attach limit rejects a pod bringing a
+        new disk but accepts one re-mounting an attached disk read-only
+        (reused volumes don't count against the limit, and both-read-only
+        shares pass NoDiskConflict)."""
+        nodes = [mk_node("full", cpu="64"), mk_node("empty")]
+        existing = [
+            mk_pod(f"e{i}", node="full", cpu="100m",
+                   volumes=[gce_vol(f"disk-{i * 8 + j}", ro=True)
+                            for j in range(8)])
+            for i in range(2)]
+        # "reuse" needs 8 cores so only "full" can take it: scheduling there
+        # proves the attached-disk reuse is exempt from the count
+        pending = [mk_pod("new", cpu="100m", volumes=[gce_vol("disk-new")]),
+                   mk_pod("reuse", cpu="8",
+                          volumes=[gce_vol("disk-0", ro=True)])]
+        a, b = two_args(nodes, existing)
+        got = assert_same(nodes, existing, pending, a, b)
+        assert got[0] == "empty"
+        assert got[1] == "full"
 
     def test_volume_zone_conflict(self):
         pvs = [api.PersistentVolume(
