@@ -4,13 +4,33 @@
 Mirrors the reference's scheduler_perf harness shapes
 (test/component/scheduler/perf/util.go:85-131: nodes 4 CPU / 32Gi / 110-pod
 cap; pause pods requesting 100m / 500Mi) scaled to BASELINE.json config #5
-(30k pods / 5k nodes), with zones, a service for spread scoring, taints and
-node labels so the full default-provider predicate/priority surface is
-exercised.
+(30k pods / 5k nodes), with zones, a service for spread scoring, taints,
+node labels, AND feature-bearing pods (hard/preferred inter-pod
+(anti-)affinity, EBS/GCE volumes, host ports) so every optional scan carry
+of the default-provider kernel is actually traced and timed — not just the
+lean capacity+spread scan (round-3 advisor finding #1).
+
+Timing methodology (round-3 advisor finding #2 — the old min-of-3 with
+block_until_ready produced a physically impossible 100µs for a 30k-step
+sequential scan on the experimental axon backend):
+
+- every timed run perturbs one input element, so no dispatch is a repeat of
+  the previous one;
+- the per-run sync barrier is HOST MATERIALIZATION of the [P] assignment
+  vector (np.asarray), which cannot complete without the scan having run —
+  a non-blocking block_until_ready can't fake it;
+- the estimate is the MEDIAN of >= BENCH_RUNS runs, never the min;
+- a back-to-back throughput cross-check (K dispatches with distinct inputs,
+  all materialized at the end, total/K) bounds the per-run number from
+  below: if the median is implausibly smaller, the cross-check wins;
+- the whole steady-state loop runs under the hang watchdog
+  (run_with_timeout), so a TPU stall after a successful compile cannot
+  wedge the bench.
 
 Prints ONE JSON line:
   metric       pods scheduled per second through the TPU kernel (steady-state
-               device wall-clock, excluding host tensorize + compile)
+               device wall-clock incl. result download, excluding host
+               tensorize + compile)
   vs_baseline  value / 30000 — fraction of the "30k pods in <1s" north star
                (1.0 = north star met; the reference Go scheduler achieves
                ~0.001-0.002 on this workload)
@@ -70,12 +90,60 @@ def build_cluster():
         if i % 50 == 7:
             kw["tolerations"] = [api.Toleration(key="dedicated",
                                                 operator="Exists")]
+        # feature-bearing pods so the full carry surface is traced+timed
+        # (terms dedupe by (namespaces, selector, topology), so a few group
+        # shapes repeated over thousands of pods keep the term tables tiny —
+        # the realistic workload shape: RC-stamped pods share their terms)
+        if i % 500 == 250:
+            # hard self-anti-affinity by hostname within a small group
+            labels["aa"] = f"g{i % 7}"
+            kw["affinity"] = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(
+                            match_labels={"aa": f"g{i % 7}"}),
+                        topology_key=api.LABEL_HOSTNAME)]))
+        elif i % 500 == 0:
+            # preferred zone-affinity toward the web service's pods
+            kw["affinity"] = api.Affinity(pod_affinity=api.PodAffinity(
+                preferred_during_scheduling_ignored_during_execution=[
+                    api.WeightedPodAffinityTerm(
+                        weight=5,
+                        pod_affinity_term=api.PodAffinityTerm(
+                            label_selector=api.LabelSelector(
+                                match_labels={"app": "web"}),
+                            topology_key=api.LABEL_ZONE))]))
+        elif i % 997 == 1:
+            # hard zone-affinity to web pods (satisfied in-batch: pod 0 is
+            # app=web and commits first in FIFO order)
+            kw["affinity"] = api.Affinity(pod_affinity=api.PodAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(
+                            match_labels={"app": "web"}),
+                        topology_key=api.LABEL_ZONE)]))
+        volumes = None
+        if i % 301 == 0:
+            volumes = [api.Volume(
+                name="data",
+                aws_elastic_block_store=api.AWSElasticBlockStoreVolumeSource(
+                    volume_id=f"vol-{i % 40}"))]
+        elif i % 401 == 0:
+            volumes = [api.Volume(
+                name="data",
+                gce_persistent_disk=api.GCEPersistentDiskVolumeSource(
+                    pd_name=f"pd-{i % 40}", read_only=True))]
+        ports = None
+        if i % 203 == 0:
+            ports = [api.ContainerPort(container_port=8080,
+                                       host_port=8000 + (i % 100))]
         pending.append(api.Pod(
             metadata=api.ObjectMeta(name=f"pod-{i:05d}", namespace="default",
                                     labels=labels),
             spec=api.PodSpec(
+                volumes=volumes,
                 containers=[api.Container(
-                    name="c", image="pause",
+                    name="c", image="pause", ports=ports,
                     resources=api.ResourceRequirements(
                         requests={"cpu": "100m", "memory": "500Mi"}))],
                 **kw)))
@@ -211,31 +279,68 @@ def main():
 
     weights = Weights()
     feats = features_of(ct)
+    import numpy as np
+    n_runs = max(1, int(os.environ.get("BENCH_RUNS", 10)))
+
+    def perturb(k):
+        """Fresh input dict differing in one element — every dispatch is
+        distinct, so no layer can serve a cached previous answer. The value
+        tweak (+k µCPU on node 0's existing usage) is far below any predicate
+        threshold, so assignments are unchanged."""
+        a = dict(arrays)
+        a["used0"] = arrays["used0"].at[0, 0].add(np.float32(k) * 1e-3)
+        return a
+
     try:
         def compile_and_run():
             out = _schedule_jit(arrays, ct.n_zones, weights, feats)
-            jax.block_until_ready(out)
-            return out
-        out = run_with_timeout(compile_and_run, 900, "kernel compile")
+            # host materialization is the sync barrier (see module docstring)
+            return np.asarray(out)
+        res_full = run_with_timeout(compile_and_run, 900, "kernel compile")
         t_compiled = time.perf_counter()
 
-        # steady state: same compiled program, fresh run
-        runs = []
-        for _ in range(3):
+        def steady_state():
+            # per-run: median of n_runs distinct dispatches, each materialized
+            runs = []
+            for k in range(1, n_runs + 1):
+                a = perturb(k)
+                jax.block_until_ready(a["used0"])  # perturbation off the clock
+                t0 = time.perf_counter()
+                np.asarray(_schedule_jit(a, ct.n_zones, weights, feats))
+                runs.append(time.perf_counter() - t0)
+            # cross-check: K back-to-back distinct dispatches, all
+            # materialized at the end; total/K bounds per-dispatch time
+            ks = list(range(n_runs + 1, 2 * n_runs + 1))
+            ins = [perturb(k) for k in ks]
+            jax.block_until_ready([a["used0"] for a in ins])
             t0 = time.perf_counter()
-            out = _schedule_jit(arrays, ct.n_zones, weights, feats)
-            jax.block_until_ready(out)
-            runs.append(time.perf_counter() - t0)
+            outs = [_schedule_jit(a, ct.n_zones, weights, feats) for a in ins]
+            for o in outs:
+                np.asarray(o)
+            b2b = (time.perf_counter() - t0) / len(ks)
+            return runs, b2b
+        runs, b2b = run_with_timeout(steady_state, 600, "steady state")
     except Exception as e:
         fail_json("kernel", e,
                   device=str(devs[0]),
                   tensorize_seconds=round(t_tensorized - t_built, 1),
                   upload_seconds=round(t_upload - t_tensorized, 1))
         return
-    best = min(runs)
 
-    import numpy as np
-    res = np.asarray(out)[: ct.n_real_pods]
+    median = float(np.median(runs))
+    # sanity gates: median must be plausible against the back-to-back bound
+    # and the run spread must be tame; otherwise the conservative number wins
+    suspect = []
+    kernel_seconds = median
+    if median < 0.5 * b2b:
+        suspect.append(f"median {median:.4f}s < half back-to-back {b2b:.4f}s")
+        kernel_seconds = b2b
+    spread = (max(runs) / min(runs)) if min(runs) > 0 else float("inf")
+    if spread > 5.0:
+        suspect.append(f"run spread {spread:.1f}x")
+        kernel_seconds = max(kernel_seconds, b2b)
+
+    res = res_full[: ct.n_real_pods]
     scheduled = int((res >= 0).sum())
 
     # correctness guard: no node overcommitted on cpu or pod slots
@@ -245,7 +350,7 @@ def main():
     cpu_used = counts * 100  # every pod requests 100m
     assert cpu_used.max() <= 4000, f"cpu overcommit: {cpu_used.max()}"
 
-    pods_per_sec = scheduled / best if best > 0 else 0.0
+    pods_per_sec = scheduled / kernel_seconds if kernel_seconds > 0 else 0.0
     result = {
         "metric": METRIC,
         "value": round(pods_per_sec, 1),
@@ -255,12 +360,18 @@ def main():
             "device": str(jax.devices()[0]),
             "scheduled": scheduled,
             "total_pods": ct.n_real_pods,
-            "kernel_seconds": round(best, 4),
+            "kernel_seconds": round(kernel_seconds, 4),
+            "kernel_seconds_median": round(median, 4),
+            "back_to_back_seconds": round(b2b, 4),
             "compile_seconds": round(t_compiled - t_upload, 1),
             "tensorize_seconds": round(t_tensorized - t_built, 1),
+            "upload_seconds": round(t_upload - t_tensorized, 1),
             "runs": [round(r, 4) for r in runs],
+            "features": {k: bool(v) for k, v in feats._asdict().items()},
         },
     }
+    if suspect:
+        result["detail"]["estimator_notes"] = suspect
     if backend_err is not None:
         result["detail"]["tpu_fallback"] = backend_err
     print(json.dumps(result))

@@ -729,7 +729,10 @@ class Tensorizer:
             n = node_index[ep.spec.node_name]
             for term in all_terms(ep, "anti"):
                 sym_entries.append((sym_t.add(ep, term), n))
-            if hw:
+            # reverse hard-affinity terms only count under a positive weight
+            # (interpod_affinity.go:143 requires hardPodAffinityWeight > 0,
+            # matching features_of's `> 0` gate)
+            if hw > 0:
                 for term in all_terms(ep, "aff"):
                     te_entries.append((te_t.add(ep, term, ("hard",)), n, hw))
             for term, w in all_terms(ep, "pref"):

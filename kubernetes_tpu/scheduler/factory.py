@@ -149,12 +149,14 @@ class ConfigFactory:
         return Scheduler(self, algorithm)
 
     def create_batch_from_provider(self, provider_name: str = DEFAULT_PROVIDER,
-                                   batch_size: int = 4096, weights=None):
+                                   batch_size: int = 4096, weights=None,
+                                   strict: bool = False):
         """The TPU-backed batch scheduler (scheduler/tpu.py) with the oracle
         from the same provider as its device-failure fallback."""
         from kubernetes_tpu.scheduler.tpu import create_batch_scheduler
         return create_batch_scheduler(self, provider_name,
-                                      batch_size=batch_size, weights=weights)
+                                      batch_size=batch_size, weights=weights,
+                                      strict=strict)
 
     # --- lifecycle -----------------------------------------------------------
 

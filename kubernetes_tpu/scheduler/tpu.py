@@ -16,6 +16,33 @@ Failure containment:
 - a device/tensorize error falls back to the sequential oracle algorithm for
   the whole drained batch, so a broken device degrades to reference behavior
   instead of wedging the queue.
+
+Failure *classification* (round-3 verdict #4): a transient device outage and
+a deterministic kernel bug must not be handled identically. Exceptions from
+the device path are classified by `_is_device_error`:
+
+- device/transport errors (XlaRuntimeError with a transient status,
+  OSError/ConnectionError/TimeoutError) retry with exponential backoff —
+  the kernel is skipped until the backoff window passes, and after
+  `degraded_after` consecutive failures the scheduler flips to the visible
+  "degraded" health state (still retrying, capped backoff);
+- anything else is a programming error: the scheduler flips to the "failed"
+  health state, the occurrence is logged at ERROR with the full traceback,
+  and the device path is disabled for a long cooldown (bug_cooldown,
+  default 5 min) rather than forever — a data-dependent tensorize error
+  from one poison pod must not condemn the process to the Python oracle for
+  its lifetime; a *real* deterministic bug re-fails (and re-logs at ERROR)
+  on every re-arm, keeping health at "failed". With strict=True a
+  programming error re-raises so tests/CI can't miss it.
+- a device error that persists `fail_after` consecutive batches is treated
+  as a permanent outage: same failed-state/cooldown handling, but with its
+  own reason label ("persistent-device") and log message so operators
+  aren't sent chasing kernel code for a transport fault.
+
+Health is exported as the `scheduler_kernel_health` gauge (1 ok / 0.5
+degraded / 0 failed) plus `scheduler_kernel_fallbacks_total{reason=...}`;
+`healthy()` is the hook the scheduler component entrypoint serves as
+/healthz.
 """
 
 from __future__ import annotations
@@ -23,6 +50,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import traceback
 from typing import List, Optional
 
 from kubernetes_tpu.api import types as api
@@ -32,6 +60,37 @@ from kubernetes_tpu.scheduler.generic import FitError
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 log = logging.getLogger("scheduler.tpu")
+
+HEALTH_OK = "ok"
+HEALTH_DEGRADED = "degraded"   # consecutive device errors; still retrying
+HEALTH_FAILED = "failed"       # deterministic bug; device path disabled
+
+_HEALTH_GAUGE = {HEALTH_OK: 1.0, HEALTH_DEGRADED: 0.5, HEALTH_FAILED: 0.0}
+
+# XLA runtime statuses that indicate the *device/runtime* (not our program)
+# failed. Everything else from XlaRuntimeError (INVALID_ARGUMENT,
+# FAILED_PRECONDITION, UNIMPLEMENTED...) is deterministic for a fixed input.
+# RESOURCE_EXHAUSTED is deliberately NOT here: OOM at a fixed batch shape
+# reproduces every retry. INTERNAL stays (the axon transport surfaces tunnel
+# failures as INTERNAL) — a *deterministic* INTERNAL is caught by the
+# consecutive-failure limit in _on_kernel_failure instead.
+_TRANSIENT_XLA_STATUS = (
+    "UNAVAILABLE", "INTERNAL", "DEADLINE_EXCEEDED",
+    "CANCELLED", "ABORTED", "UNKNOWN",
+)
+
+
+def _is_device_error(e: BaseException) -> bool:
+    """True when the failure is plausibly transient (device/transport), false
+    for deterministic programming errors."""
+    name = type(e).__name__
+    if name in ("XlaRuntimeError", "JaxRuntimeError"):
+        # XlaRuntimeError messages lead with "STATUS: detail" — match the
+        # leading token only, so a deterministic error merely *quoting* a
+        # transient status isn't misclassified
+        status = str(e).split(":", 1)[0].strip()
+        return status in _TRANSIENT_XLA_STATUS
+    return isinstance(e, (OSError, ConnectionError, TimeoutError))
 
 
 class BatchScheduler(Scheduler):
@@ -44,19 +103,98 @@ class BatchScheduler(Scheduler):
 
     def __init__(self, factory: ConfigFactory, algorithm,
                  batch_size: int = 4096, weights: Optional[Weights] = None,
-                 bind_workers: int = 32):
+                 bind_workers: int = 32, strict: bool = False,
+                 degraded_after: int = 3, fail_after: int = 10,
+                 retry_initial: float = 1.0, retry_max: float = 60.0,
+                 bug_cooldown: float = 300.0, clock=time.monotonic):
         super().__init__(factory, algorithm)
         self.batch_size = batch_size
         self.weights = weights or Weights()
         self.kernel_batches = 0     # successful device batches
         self.kernel_pods = 0        # pods placed via the device path
         self.kernel_failures = 0    # device/tensorize errors (fell back)
+        self.strict = strict        # re-raise programming errors
+        self.disabled_reason: Optional[str] = None
+        self._degraded_after = degraded_after
+        self._fail_after = fail_after  # consecutive "transient" errors -> failed
+        self._consecutive_device_errors = 0
+        self._retry_initial = retry_initial
+        self._retry_max = retry_max
+        self._retry_backoff = retry_initial
+        self._retry_at = 0.0        # monotonic time before which kernel is skipped
+        self._bug_cooldown = bug_cooldown
+        self._clock = clock
+        self._set_health(HEALTH_OK)
         from concurrent.futures import ThreadPoolExecutor
         self._bind_pool = ThreadPoolExecutor(
             max_workers=bind_workers, thread_name_prefix="binder")
 
+    # --- health / escalation (round-3 verdict #4) ----------------------------
+
+    def healthy(self) -> bool:
+        return self.health == HEALTH_OK
+
+    def kernel_available(self) -> bool:
+        """Is the device path currently eligible to run? (The failed state
+        re-arms after its cooldown; health stays "failed" until a success.)"""
+        return self._clock() >= self._retry_at
+
+    def _set_health(self, state: str):
+        self.health = state
+        METRICS.set_gauge("scheduler_kernel_health", _HEALTH_GAUGE[state])
+
+    def _on_kernel_success(self):
+        self._consecutive_device_errors = 0
+        self._retry_backoff = self._retry_initial
+        self._retry_at = 0.0
+        if self.health != HEALTH_OK:
+            log.info("device kernel recovered from %s; health back to ok",
+                     self.health)
+            self.disabled_reason = None
+        self._set_health(HEALTH_OK)
+
+    def _on_kernel_failure(self, e: Exception, n_pods: int):
+        self.kernel_failures += 1
+        is_dev = _is_device_error(e)
+        if is_dev and self._consecutive_device_errors + 1 < self._fail_after:
+            METRICS.inc("scheduler_kernel_fallbacks_total", reason="device")
+            self._consecutive_device_errors += 1
+            self._retry_at = self._clock() + self._retry_backoff
+            self._retry_backoff = min(self._retry_backoff * 2, self._retry_max)
+            if self._consecutive_device_errors >= self._degraded_after:
+                self._set_health(HEALTH_DEGRADED)
+            log.warning(
+                "device error on batch of %d (%d consecutive, retry in %.0fs,"
+                " health=%s): %s", n_pods, self._consecutive_device_errors,
+                max(self._retry_at - self._clock(), 0), self.health, e)
+            return
+        # failed state: loud, visible, and disabled for a long cooldown —
+        # silently scheduling every batch through the Python oracle at a
+        # warning log level is the round-2/3 advisor finding this closes
+        reason = "persistent-device" if is_dev else "bug"
+        METRICS.inc("scheduler_kernel_fallbacks_total", reason=reason)
+        self.disabled_reason = f"{reason}: {e!r}"
+        self._retry_at = self._clock() + self._bug_cooldown
+        self._set_health(HEALTH_FAILED)
+        if is_dev:
+            log.error(
+                "device error persisted %d consecutive batches — treating as "
+                "an outage; device path DISABLED for %.0fs: %s",
+                self._consecutive_device_errors + 1, self._bug_cooldown, e)
+        else:
+            log.error(
+                "DETERMINISTIC kernel bug — device path DISABLED for %.0fs, "
+                "batches run the sequential fallback:\n%s",
+                self._bug_cooldown, traceback.format_exc())
+
     def _spawn_bind(self, pod, dest, t_start, did_assume):
         self._bind_pool.submit(self._bind, pod, dest, t_start, did_assume)
+
+    def _fallback_sequential(self, pods):
+        """Schedule a drained batch through the sequential oracle — the one
+        place batch-drop safety lives."""
+        for pod in pods:
+            self._schedule_pod(pod)
 
     # --- one batch (the batched scheduleOne) ---------------------------------
 
@@ -70,6 +208,15 @@ class BatchScheduler(Scheduler):
         pods = [first] + self.f.pending.drain(self.batch_size - 1)
         t_start = time.perf_counter()
 
+        if not self.kernel_available():
+            # disabled (failed-state cooldown) or inside the device-error
+            # backoff window: sequential path, no device attempt
+            self._fallback_sequential(pods)
+            return len(pods)
+
+        # host-side snapshot failures are NOT kernel failures: fall back with
+        # a warning, no health impact (the classifier must only ever see
+        # exceptions from the tensorize/device path)
         try:
             info = self.f.cache.get_node_name_to_info_map()
             nodes = self.f.node_lister.list()
@@ -83,6 +230,12 @@ class BatchScheduler(Scheduler):
             # still matter for nothing the kernel models per-node, so drop
             existing = [p for name, ni in info.items() if name in node_set
                         for p in ni.pods]
+        except Exception as e:
+            log.warning("cluster snapshot failed (%s); sequential fallback", e)
+            self._fallback_sequential(pods)
+            return len(pods)
+
+        try:
             with METRICS.time("scheduler_scheduling_algorithm_latency_seconds"):
                 results = self._run_kernel(nodes, existing, pods)
             if len(results) != len(pods):
@@ -90,13 +243,15 @@ class BatchScheduler(Scheduler):
                     f"kernel returned {len(results)} results for "
                     f"{len(pods)} pods")
         except Exception as e:
-            self.kernel_failures += 1
-            log.warning("TPU batch of %d failed (%s); sequential fallback",
-                        len(pods), e)
-            for pod in pods:
-                self._schedule_pod(pod)
+            self._on_kernel_failure(e, len(pods))
+            # fallback first — the drained batch must never be dropped, even
+            # when strict mode re-raises below
+            self._fallback_sequential(pods)
+            if self.strict and not _is_device_error(e):
+                raise
             return len(pods)
 
+        self._on_kernel_success()
         self.kernel_batches += 1
         for pod, dest in zip(pods, results):
             if dest is None:
@@ -121,6 +276,11 @@ class BatchScheduler(Scheduler):
                 self.schedule_batch_once(timeout=0.5)
             except Exception:
                 log.exception("scheduleBatchOnce crashed")  # HandleCrash
+                if self.strict and self.health == HEALTH_FAILED:
+                    # strict mode: a deterministic kernel bug HALTS the
+                    # scheduler instead of degrading to the Python loop
+                    log.error("strict mode: stopping scheduler loop")
+                    self._stop.set()
 
     def stop(self):
         super().stop()
@@ -130,7 +290,8 @@ class BatchScheduler(Scheduler):
 def create_batch_scheduler(factory: ConfigFactory,
                            provider_name: Optional[str] = None,
                            batch_size: int = 4096,
-                           weights: Optional[Weights] = None) -> BatchScheduler:
+                           weights: Optional[Weights] = None,
+                           strict: bool = False) -> BatchScheduler:
     """Build a BatchScheduler whose fallback algorithm is the oracle built
     from the same provider (CreateFromProvider seam, factory.go:248-342)."""
     from kubernetes_tpu.scheduler.generic import GenericScheduler
@@ -142,4 +303,4 @@ def create_batch_scheduler(factory: ConfigFactory,
     priorities = get_priorities(prov["priorities"], factory.plugin_args)
     algorithm = GenericScheduler(predicates, priorities)
     return BatchScheduler(factory, algorithm, batch_size=batch_size,
-                          weights=weights)
+                          weights=weights, strict=strict)

@@ -1,0 +1,202 @@
+"""Kernel failure classification and escalation (round-3 verdict #4).
+
+A transient device outage and a deterministic kernel bug must diverge:
+device errors retry with backoff and flip a visible "degraded" state after N
+consecutive failures; a programming error disables the device path
+permanently ("failed"), logs at ERROR, and with strict=True re-raises.
+The reference analogue is HandleCrash-plus-healthz visibility — a component
+that silently stops doing its job is the failure mode being closed
+(plugin/cmd/kube-scheduler/app/server.go:92-108 healthz mux).
+"""
+
+import logging
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+from kubernetes_tpu.scheduler.tpu import (
+    HEALTH_DEGRADED, HEALTH_FAILED, HEALTH_OK, _is_device_error,
+)
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+from tests.test_batch_scheduler import mk_node, mk_pod, wait_scheduled
+
+
+class XlaRuntimeError(RuntimeError):
+    """Stand-in with the real jaxlib exception's type name (classification
+    is by name so jaxlib needn't be imported on the hot path)."""
+
+
+class TestClassification:
+    def test_transient_xla_statuses_are_device_errors(self):
+        assert _is_device_error(XlaRuntimeError("UNAVAILABLE: tunnel down"))
+        assert _is_device_error(XlaRuntimeError("INTERNAL: core dumped"))
+        assert _is_device_error(ConnectionError("refused"))
+        assert _is_device_error(TimeoutError())
+        assert _is_device_error(OSError("broken pipe"))
+
+    def test_deterministic_errors_are_bugs(self):
+        assert not _is_device_error(XlaRuntimeError(
+            "INVALID_ARGUMENT: shape mismatch"))
+        assert not _is_device_error(KeyError("req_hit0"))
+        assert not _is_device_error(TypeError("bad arg"))
+        assert not _is_device_error(RuntimeError(
+            "kernel returned 3 results for 5 pods"))
+        # OOM at a fixed batch shape reproduces every retry
+        assert not _is_device_error(XlaRuntimeError(
+            "RESOURCE_EXHAUSTED: out of memory allocating carry"))
+        # a deterministic error QUOTING a transient token stays a bug
+        assert not _is_device_error(XlaRuntimeError(
+            "INVALID_ARGUMENT: op 'scan' state UNKNOWN shape"))
+
+
+@pytest.fixture()
+def cluster():
+    server = APIServer().start()
+    client = RESTClient.for_server(server, qps=5000, burst=5000)
+    for i in range(4):
+        client.create("nodes", mk_node(f"n-{i}"))
+    factory = ConfigFactory(client)
+    factory.run()
+    yield client, factory
+    factory.stop()
+    server.stop()
+
+
+def make_sched(factory, **kw):
+    return factory.create_batch_from_provider(batch_size=64, **kw)
+
+
+class TestEscalation:
+    def test_deterministic_bug_disables_device_path(self, cluster, caplog):
+        client, factory = cluster
+        sched = make_sched(factory)
+        calls = []
+
+        def broken_kernel(nodes, existing, pending):
+            calls.append(len(pending))
+            raise TypeError("carry shape bug")
+
+        sched._run_kernel = broken_kernel
+        for i in range(8):
+            client.create("pods", mk_pod(f"p-{i}"))
+        with caplog.at_level(logging.ERROR, logger="scheduler.tpu"):
+            sched.run()
+            try:
+                wait_scheduled(client, 8, timeout=30)
+            finally:
+                sched.stop()
+        # fallback still placed every pod...
+        assert sched.health == HEALTH_FAILED
+        assert not sched.healthy()
+        assert "carry shape bug" in (sched.disabled_reason or "")
+        # ...but the bug surfaced at ERROR with a traceback, not a warning
+        errors = [r for r in caplog.records if r.levelno >= logging.ERROR]
+        assert errors and "DISABLED" in errors[0].getMessage()
+        # the device path was tried exactly once, then never again
+        assert len(calls) == 1
+        assert not sched.kernel_available()
+        assert METRICS.counter_value("scheduler_kernel_fallbacks_total",
+                                     reason="bug") >= 1
+
+    def test_device_errors_backoff_then_degrade_then_recover(self, cluster):
+        client, factory = cluster
+        sched = make_sched(factory)
+        now = [100.0]
+        sched._clock = lambda: now[0]
+        fail = [True]
+        calls = []
+
+        real_kernel = sched._run_kernel
+
+        def flaky_kernel(nodes, existing, pending):
+            calls.append(len(pending))
+            if fail[0]:
+                raise XlaRuntimeError("UNAVAILABLE: device tunnel down")
+            return real_kernel(nodes, existing, pending)
+
+        sched._run_kernel = flaky_kernel
+
+        # 3 consecutive device failures -> degraded, each with fallback
+        # (one pod per round so each round drains a fresh one-pod batch)
+        for k in range(3):
+            client.create("pods", mk_pod(f"d-{k}"))
+            now[0] += 1000  # jump past any backoff window
+            n = 0
+            while n == 0:
+                n = sched.schedule_batch_once(timeout=2.0)
+            assert sched.health == (HEALTH_DEGRADED if k == 2 else HEALTH_OK)
+        assert len(calls) == 3
+        assert sched._consecutive_device_errors == 3
+
+        # inside the backoff window the kernel isn't even attempted
+        assert not sched.kernel_available()
+        client.create("pods", mk_pod("d-skip"))
+        n = 0
+        while n == 0:
+            n = sched.schedule_batch_once(timeout=2.0)
+        assert len(calls) == 3  # no new device attempt
+
+        # past the window, a success resets health to ok
+        fail[0] = False
+        now[0] += 1000
+        assert sched.kernel_available()
+        client.create("pods", mk_pod("d-ok"))
+        n = 0
+        while n == 0:
+            n = sched.schedule_batch_once(timeout=2.0)
+        assert len(calls) == 4
+        assert sched.health == HEALTH_OK
+        assert sched._consecutive_device_errors == 0
+        wait_scheduled(client, 5, timeout=10)
+
+    def test_persistent_device_errors_escalate_to_failed(self, cluster):
+        """A 'transient' status that reproduces fail_after times in a row is
+        deterministic in practice — it must stop burning a device attempt
+        per backoff window forever."""
+        client, factory = cluster
+        sched = make_sched(factory)
+        sched._fail_after = 4
+        now = [0.0]
+        sched._clock = lambda: now[0]
+
+        def down(*a):
+            raise XlaRuntimeError("INTERNAL: tunnel reset")
+
+        sched._run_kernel = down
+        for k in range(4):
+            client.create("pods", mk_pod(f"e-{k}"))
+            now[0] += 1000
+            n = 0
+            while n == 0:
+                n = sched.schedule_batch_once(timeout=2.0)
+        assert sched.health == HEALTH_FAILED
+        assert not sched.kernel_available()
+        # labeled as an outage, not a kernel bug
+        assert "persistent-device" in sched.disabled_reason
+        assert METRICS.counter_value("scheduler_kernel_fallbacks_total",
+                                     reason="persistent-device") >= 1
+        # the failed state re-arms after the cooldown and can recover
+        sched._run_kernel = real = sched.__class__._run_kernel.__get__(sched)
+        client.create("pods", mk_pod("e-rec"))
+        now[0] += 10_000
+        assert sched.kernel_available()
+        n = 0
+        while n == 0:
+            n = sched.schedule_batch_once(timeout=2.0)
+        assert sched.health == HEALTH_OK and sched.disabled_reason is None
+        wait_scheduled(client, 5, timeout=10)
+
+    def test_strict_mode_reraises_bugs(self, cluster):
+        client, factory = cluster
+        sched = make_sched(factory, strict=True)
+        sched._run_kernel = lambda *a: (_ for _ in ()).throw(
+            KeyError("missing tensor"))
+        client.create("pods", mk_pod("s-0"))
+        with pytest.raises(KeyError):
+            n = 0
+            while n == 0:
+                n = sched.schedule_batch_once(timeout=2.0)
