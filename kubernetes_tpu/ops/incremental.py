@@ -1,0 +1,1398 @@
+"""Incremental tensorization: the device-resident cluster mirror.
+
+The full Tensorizer (ops/tensorize.py) rebuilds the world from Python
+objects per batch — the anti-pattern the reference itself suffers in its
+clone-per-decision cache (plugin/pkg/scheduler/schedulercache/cache.go:77-85)
+and SURVEY §7 hard part #2 exists to kill. This module maintains the same
+tensors *incrementally*:
+
+- **Node-side state** (statics + placed-pod aggregates) is mirrored from
+  SchedulerCache delta events (cache.add_listener): every array is updated
+  in O(changed cells) when a node or placed pod changes, with reversible
+  count representations (occupancy = clipped counts, affinity hit tables =
+  per-domain match counts) so removals are exact.
+- **Vocabularies are stable and grow-only** across batches (labels, taints,
+  ports, images, zones, topology keys, disk/volume ids, affinity
+  expressions/terms, spread groups), so array columns keep their meaning
+  and the jit cache stays warm.
+- **Pod-side tensors** are built per batch, vectorized through per-shape
+  memoization: pods stamped from the same template (the RC/kubemark/bench
+  reality) share every derived row, so a 30k-pod batch parses each distinct
+  shape once. No per-pod imports, no O(P×T) toleration double-loop.
+- **Device residency**: DeviceCache re-uploads only arrays whose version
+  bumped since the last batch; indicator matrices travel as int8 (4× less
+  HBM traffic than f32) and are cast on-device by the kernel.
+
+Semantic deltas vs the full Tensorizer (both deliberate):
+- hit tables carry match *counts* instead of 0/1 — the kernel only ever
+  tests >0 / ==0 on them, and counts make removal exact;
+- pods on currently-unschedulable nodes still contribute inter-pod affinity
+  domain hits (the reference's InterPodAffinity lists ALL pods,
+  predicates.go:774; the full Tensorizer only sees pods on listed nodes).
+
+Reference seams mirrored: schedulercache delta flow (cache.go:101-156),
+NodeInfo aggregation (node_info.go:118-156), the tensor layout contract of
+ops/tensorize.py (ClusterTensors).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api import labels as labelsel
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.ops.tensorize import (
+    MB, ClusterTensors, Vocab, _is_best_effort, _labels_of, _pad,
+    _pod_ports_set, _pod_req_vec, _selector_signature, _zone_key,
+)
+from kubernetes_tpu.client.listers import node_is_ready
+
+LANE = 128   # TPU lane width: last-axis pad for big one-hot matrices
+SUB = 8      # sublane pad for small term axes
+
+
+def _grow(arr: np.ndarray, shape: Tuple[int, ...]) -> np.ndarray:
+    out = np.zeros(shape, arr.dtype)
+    out[tuple(slice(0, s) for s in arr.shape)] = arr
+    return out
+
+
+def _pod_key(pod: api.Pod) -> str:
+    m = pod.metadata
+    return f"{m.namespace}/{m.name}" if m else ""
+
+
+def _labels_sig(pod: api.Pod):
+    return (pod.metadata.namespace if pod.metadata else "",
+            tuple(sorted((_labels_of(pod)).items())))
+
+
+def _bucket(p: int) -> int:
+    """Pod-axis padding: next power of two >= 8 — few distinct shapes keep
+    the jit cache warm across variable batch sizes."""
+    n = SUB
+    while n < p:
+        n *= 2
+    return n
+
+
+class _TermTable:
+    """Grow-only deduped inter-pod term rows with reversible per-node
+    domain-hit counts. Rows: (namespaces frozenset|None, selector,
+    topo key ids, weight)."""
+
+    def __init__(self, n_cap: int, weighted: bool = False):
+        self.vocab = Vocab()
+        self.rows: List[tuple] = []
+        self.hits = np.zeros((SUB, n_cap), np.float64 if weighted else np.int32)
+        self.totals = np.zeros(SUB, np.int64)  # matches anywhere, per term
+        self.weighted = weighted
+
+    def padded(self) -> int:
+        return max(SUB, _pad(len(self.rows), SUB))
+
+    def grow_nodes(self, n_cap: int):
+        self.hits = _grow(self.hits, (self.hits.shape[0], n_cap))
+
+    def ensure_rows(self):
+        need = self.padded()
+        if self.hits.shape[0] < need:
+            self.hits = _grow(self.hits, (need, self.hits.shape[1]))
+            self.totals = _grow(self.totals, (need,))
+
+    def add(self, key, names, sel, kids, weight=None) -> Tuple[int, bool]:
+        tid = self.vocab.get(key)
+        if tid is not None:
+            return tid, False
+        tid = self.vocab.id(key)
+        self.rows.append((names, sel, kids, weight))
+        self.ensure_rows()
+        return tid, True
+
+    def matches(self, tid: int, ns: str, lbls: dict) -> bool:
+        names, sel, _, _ = self.rows[tid]
+        if names is not None and ns not in names:
+            return False
+        return sel.matches(lbls)
+
+class IncrementalTensorizer:
+    """Mirrors cluster state as device-ready arrays; listener side of
+    SchedulerCache.add_listener (called under the cache lock)."""
+
+    def __init__(self, plugin_args=None,
+                 failure_domains=(api.LABEL_HOSTNAME, api.LABEL_ZONE,
+                                  api.LABEL_REGION),
+                 node_cap: int = LANE):
+        self.args = plugin_args
+        self.failure_domains = tuple(failure_domains)
+        self._lock = threading.RLock()
+        self._versions: Dict[str, int] = {}
+
+        # vocabs (grow-only)
+        self._labelv = Vocab()
+        self._taintv = Vocab()
+        self._portv = Vocab()
+        self._imagev = Vocab()
+        self._zonev = Vocab()
+        self._keyv = Vocab()          # topology keys
+        for k in self.failure_domains:
+            self._keyv.id(k)
+        self._domv: Dict[int, Vocab] = {}   # per topo key: value -> domain id
+        self._diskv = Vocab()
+        self._ebsv = Vocab()
+        self._gcev = Vocab()
+        self._groupv = Vocab()        # spread-group signature -> gid
+        self._group_rows: List[Tuple[str, list]] = []   # (ns, selectors)
+
+        # node slots
+        N = node_cap
+        self._node_index: Dict[str, int] = {}
+        self._free: List[int] = []
+        self._hi = 0                  # high-water slot
+        self._node_names: List[str] = [""] * N
+        self._node_labels_d: Dict[int, dict] = {}   # slot -> labels dict
+        self._node_images_d: Dict[int, dict] = {}   # slot -> image -> MiB
+        self._slot_pods: Dict[int, int] = {}        # slot -> placed-pod count
+
+        # node statics
+        self.alloc = np.zeros((N, 4), np.float32)
+        self.node_labels = np.zeros((N, LANE), np.int8)
+        self.taints_nosched = np.zeros((N, LANE), np.int8)
+        self.taints_prefer = np.zeros((N, LANE), np.int8)
+        self.mem_pressure = np.zeros(N, bool)
+        self.node_valid = np.zeros(N, bool)
+        self.zone_id = np.full(N, -1, np.int32)
+        self.image_node_sizes = np.zeros((N, LANE), np.float32)
+        self.node_dom = np.full((_pad(len(self._keyv), SUB), N), -1, np.int32)
+
+        # placed-pod aggregates (counts internal, clipped occupancy exposed)
+        self.used0 = np.zeros((N, 4), np.float64)
+        self.used0_nonzero = np.zeros((N, 2), np.float64)
+        self._ports_cnt = np.zeros((N, LANE), np.int16)
+        self.node_ports0 = np.zeros((N, LANE), np.int8)
+        self._disk_any_cnt = np.zeros((N, LANE), np.int16)
+        self._disk_rw_cnt = np.zeros((N, LANE), np.int16)
+        self.node_disk_any0 = np.zeros((N, LANE), np.int8)
+        self.node_disk_rw0 = np.zeros((N, LANE), np.int8)
+        self._ebs_cnt = np.zeros((N, LANE), np.int16)
+        self.node_ebs0 = np.zeros((N, LANE), np.int8)
+        self._gce_cnt = np.zeros((N, LANE), np.int16)
+        self.node_gce0 = np.zeros((N, LANE), np.int8)
+        self.group_counts0 = np.zeros((N, SUB), np.int32)
+
+        # inter-pod term tables: pending-owned (req/anti/pref) + placed-owned
+        # (sym = anti terms of placed pods, te = weighted reverse scores)
+        self.req_t = _TermTable(N)
+        self.anti_t = _TermTable(N)
+        self.pref_t = _TermTable(N)
+        self.sym_t = _TermTable(N)
+        self.te_t = _TermTable(N, weighted=True)
+
+        # placed-pod registry, grouped by (ns, labels signature) for fast
+        # new-term/new-group initialization scans
+        self._placed: Dict[str, Tuple[api.Pod, int]] = {}
+        self._by_sig: Dict[tuple, Dict[str, int]] = {}
+        self._terminating: set = set()
+
+        # node-affinity expression machinery
+        self._exprv = Vocab()          # (key, op, values) -> expr id
+        self._expr_reqs: List[labelsel.Requirement] = []
+        self.expr_node = np.zeros((SUB, N), np.int8)
+        self._termv = Vocab()          # tuple(expr ids) -> term id
+        self._term_exprs: List[List[int]] = []
+        self._prefv = Vocab()          # (term id, weight) -> pref entry id
+        self._pref_entries: List[Tuple[int, float]] = []
+        self.pref_term_node = np.zeros((SUB, N), np.int8)
+        self.pref_weight = np.zeros(SUB, np.float32)
+
+        # cross-batch pod-shape memo (pure spec derivations only)
+        self._shape_memo: Dict[tuple, dict] = {}
+        self._match_memo: Dict[tuple, dict] = {}   # (ns, labels) -> per-table ids
+
+        # stats for the bench
+        self.builds = 0
+        self.pod_events = 0
+        self.node_events = 0
+        self.last_build_seconds = 0.0
+        self.last_upload_bytes = 0
+        # a listener callback that threw means this mirror missed an event:
+        # it must refuse to schedule (the cache isolates listener exceptions,
+        # so without this flag the staleness would be silent)
+        self.broken: Optional[str] = None
+
+    # --- dirty tracking ------------------------------------------------------
+
+    def _touch(self, *names: str):
+        for n in names:
+            self._versions[n] = self._versions.get(n, 0) + 1
+
+    @property
+    def n_cap(self) -> int:
+        return self.alloc.shape[0]
+
+    # --- capacity growth -----------------------------------------------------
+
+    def _grow_nodes(self):
+        N = self.n_cap * 2
+        for name in ("alloc", "node_labels", "taints_nosched", "taints_prefer",
+                     "mem_pressure", "node_valid", "image_node_sizes",
+                     "used0", "used0_nonzero", "_ports_cnt", "node_ports0",
+                     "_disk_any_cnt", "_disk_rw_cnt", "node_disk_any0",
+                     "node_disk_rw0", "_ebs_cnt", "node_ebs0", "_gce_cnt",
+                     "node_gce0", "group_counts0", "expr_node",
+                     "pref_term_node"):
+            arr = getattr(self, name)
+            shape = (N,) + arr.shape[1:] if arr.ndim > 1 or name in (
+                "mem_pressure", "node_valid") else (N,)
+            if name in ("expr_node", "pref_term_node"):
+                shape = (arr.shape[0], N)
+            setattr(self, name, _grow(arr, shape))
+        zid = np.full(N, -1, np.int32)
+        zid[: self.zone_id.shape[0]] = self.zone_id
+        self.zone_id = zid
+        nd = np.full((self.node_dom.shape[0], N), -1, np.int32)
+        nd[:, : self.node_dom.shape[1]] = self.node_dom
+        self.node_dom = nd
+        for t in (self.req_t, self.anti_t, self.pref_t, self.sym_t, self.te_t):
+            t.grow_nodes(N)
+        self._node_names.extend([""] * (N - len(self._node_names)))
+        self._touch("alloc", "node_labels", "taints_nosched", "taints_prefer",
+                    "mem_pressure", "node_valid", "zone_id", "image_node_sizes",
+                    "node_dom", "used0", "used0_nonzero", "node_ports0",
+                    "node_disk_any0", "node_disk_rw0", "node_ebs0",
+                    "node_gce0", "group_counts0", "expr_node", "pref_term_node",
+                    "req_hit0", "anti_hit0", "pref_hit0", "sym_dom0", "te_dom0")
+
+    def _grow_cols(self, name: str, vocab: Vocab, pad: int = LANE,
+                   extra: Tuple[str, ...] = ()):
+        """Widen a [N, C] column family when its vocab outgrows it."""
+        arr = getattr(self, name)
+        need = _pad(len(vocab), pad)
+        if arr.shape[1] < need:
+            for n in (name,) + extra:
+                a = getattr(self, n)
+                setattr(self, n, _grow(a, (a.shape[0], need)))
+                self._touch(n)
+
+    # --- domain helpers ------------------------------------------------------
+
+    def _dom_id(self, kid: int, val: str) -> int:
+        v = self._domv.get(kid)
+        if v is None:
+            v = self._domv[kid] = Vocab()
+        return v.id(val)
+
+    def _ensure_key_rows(self):
+        need = _pad(len(self._keyv), SUB)
+        if self.node_dom.shape[0] < need:
+            nd = np.full((need, self.n_cap), -1, np.int32)
+            nd[: self.node_dom.shape[0]] = self.node_dom
+            self.node_dom = nd
+            self._touch("node_dom")
+
+    def _register_topo_key(self, key: str) -> int:
+        """New concrete topology key: backfill domain ids for all nodes."""
+        existing = self._keyv.get(key)
+        if existing is not None:
+            return existing
+        kid = self._keyv.id(key)
+        self._ensure_key_rows()
+        for slot, lbls in self._node_labels_d.items():
+            val = lbls.get(key)
+            if val:
+                self.node_dom[kid, slot] = self._dom_id(kid, val)
+        self._touch("node_dom")
+        return kid
+
+    def _domain_mask(self, slot: int, kids: List[int]) -> np.ndarray:
+        """0/1 over node slots sharing a topology domain with `slot` under
+        any of the keys (the tensorize.py domain_mask contract)."""
+        m = np.zeros(self.n_cap, np.int32)
+        for kid in kids:
+            row = self.node_dom[kid]
+            d = row[slot]
+            if d >= 0:
+                np.maximum(m, (row == d).astype(np.int32), out=m)
+        return m
+
+    # --- node events (listener interface) ------------------------------------
+
+    def node_added(self, node: api.Node):
+        try:
+            self._node_added(node)
+        except Exception as e:
+            self.broken = f"node_added({node.metadata.name}): {e!r}"
+            raise
+
+    def _node_added(self, node: api.Node):
+        with self._lock:
+            self.node_events += 1
+            name = node.metadata.name
+            slot = self._node_index.get(name)
+            if slot is None:
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    if self._hi >= self.n_cap:
+                        self._grow_nodes()
+                    slot = self._hi
+                    self._hi += 1
+                self._node_index[name] = slot
+                self._node_names[slot] = name
+                self._slot_pods.setdefault(slot, 0)
+            self._fill_node_statics(slot, node)
+
+    def node_updated(self, node: api.Node):
+        try:
+            self._node_updated(node)
+        except Exception as e:
+            self.broken = f"node_updated({node.metadata.name}): {e!r}"
+            raise
+
+    def _node_updated(self, node: api.Node):
+        with self._lock:
+            self.node_events += 1
+            slot = self._node_index.get(node.metadata.name)
+            if slot is None:
+                return self._node_added(node)
+            old_labels = self._node_labels_d.get(slot, {})
+            self._fill_node_statics(slot, node)
+            if old_labels != (_labels_of(node)):
+                # domain topology changed under the hit tables: re-derive
+                # every placed contribution (rare — heartbeats only touch
+                # status, which takes the cheap path above)
+                self._reinit_interpod()
+
+    def node_removed(self, node: api.Node):
+        try:
+            self._node_removed(node)
+        except Exception as e:
+            self.broken = f"node_removed({node.metadata.name}): {e!r}"
+            raise
+
+    def _node_removed(self, node: api.Node):
+        with self._lock:
+            self.node_events += 1
+            slot = self._node_index.get(node.metadata.name)
+            if slot is None:
+                return
+            self.node_valid[slot] = False
+            self._node_labels_d[slot] = {}
+            self._node_images_d.pop(slot, None)
+            self.node_labels[slot] = 0
+            self.taints_nosched[slot] = 0
+            self.taints_prefer[slot] = 0
+            self.node_dom[:, slot] = -1
+            self.zone_id[slot] = -1
+            self._touch("node_valid", "node_labels", "taints_nosched",
+                        "taints_prefer", "node_dom", "zone_id")
+            if not self._slot_pods.get(slot):
+                del self._node_index[node.metadata.name]
+                self._node_names[slot] = ""
+                self._free.append(slot)
+            self._reinit_interpod()
+
+    def _fill_node_statics(self, slot: int, node: api.Node):
+        a = api.node_allocatable(node)
+        self.alloc[slot] = (a[api.RESOURCE_CPU], a[api.RESOURCE_MEMORY] / MB,
+                            a[api.RESOURCE_GPU], a[api.RESOURCE_PODS])
+        lbls = _labels_of(node)
+        self._node_labels_d[slot] = lbls
+        for kv in lbls.items():
+            self._labelv.id(kv)
+        self._grow_cols("node_labels", self._labelv)
+        row = np.zeros(self.node_labels.shape[1], np.int8)
+        for kv in lbls.items():
+            row[self._labelv.get(kv)] = 1
+        self.node_labels[slot] = row
+
+        for t in ((node.spec.taints or []) if node.spec else []):
+            self._taintv.id((t.key, t.value, t.effect))
+        self._grow_cols("taints_nosched", self._taintv,
+                        extra=("taints_prefer",))
+        tns = np.zeros(self.taints_nosched.shape[1], np.int8)
+        tpf = np.zeros_like(tns)
+        for t in ((node.spec.taints or []) if node.spec else []):
+            tid = self._taintv.get((t.key, t.value, t.effect))
+            if t.effect == api.TAINT_NO_SCHEDULE:
+                tns[tid] = 1
+            elif t.effect == api.TAINT_PREFER_NO_SCHEDULE:
+                tpf[tid] = 1
+        self.taints_nosched[slot] = tns
+        self.taints_prefer[slot] = tpf
+
+        self.mem_pressure[slot] = any(
+            c.type == api.NODE_MEMORY_PRESSURE and c.status == api.CONDITION_TRUE
+            for c in ((node.status.conditions or []) if node.status else []))
+        self.node_valid[slot] = node_is_ready(node)
+
+        zk = _zone_key(node)
+        self.zone_id[slot] = self._zonev.id(zk) if zk else -1
+
+        # topology domains for every registered key
+        for key, kid in list(self._keyv.items()):
+            val = lbls.get(key)
+            self.node_dom[kid, slot] = self._dom_id(kid, val) if val else -1
+
+        # images present on the node (ImageLocality)
+        imgs = {}
+        for img in ((node.status.images or []) if node.status else []):
+            for iname in (img.names or []):
+                imgs[iname] = img.size_bytes / MB
+        self._node_images_d[slot] = imgs
+        self._grow_cols("image_node_sizes", self._imagev)
+        irow = np.zeros(self.image_node_sizes.shape[1], np.float32)
+        for iname, mib in imgs.items():
+            iid = self._imagev.get(iname)
+            if iid is not None:
+                irow[iid] = mib
+        self.image_node_sizes[slot] = irow
+
+        # node-affinity expression columns + pref term truth for this node
+        for eid, req in enumerate(self._expr_reqs):
+            self.expr_node[eid, slot] = 1 if req.matches(lbls) else 0
+        for pid, (tid, _w) in enumerate(self._pref_entries):
+            eids = self._term_exprs[tid]
+            self.pref_term_node[pid, slot] = (
+                1 if all(self.expr_node[e, slot] for e in eids) else 0)
+
+        self._touch("alloc", "node_labels", "taints_nosched", "taints_prefer",
+                    "mem_pressure", "node_valid", "zone_id", "node_dom",
+                    "image_node_sizes", "expr_node", "pref_term_node")
+
+    # --- pod events (listener interface) --------------------------------------
+
+    def _ensure_slot(self, node_name: str) -> int:
+        """Slot for a node we may not have statics for yet (pod observed
+        before its node, cache.go's NodeInfo(None) case)."""
+        slot = self._node_index.get(node_name)
+        if slot is None:
+            if self._free:
+                slot = self._free.pop()
+            else:
+                if self._hi >= self.n_cap:
+                    self._grow_nodes()
+                slot = self._hi
+                self._hi += 1
+            self._node_index[node_name] = slot
+            self._node_names[slot] = node_name
+            self._slot_pods.setdefault(slot, 0)
+        return slot
+
+    def pod_added(self, pod: api.Pod):
+        try:
+            with self._lock:
+                self.pod_events += 1
+                self._apply_pod(pod, +1)
+        except Exception as e:
+            self.broken = f"pod_added({_pod_key(pod)}): {e!r}"
+            raise
+
+    def pod_removed(self, pod: api.Pod):
+        try:
+            with self._lock:
+                self.pod_events += 1
+                self._apply_pod(pod, -1)
+        except Exception as e:
+            self.broken = f"pod_removed({_pod_key(pod)}): {e!r}"
+            raise
+
+    def _apply_pod(self, pod: api.Pod, sign: int):
+        node_name = pod.spec.node_name if pod.spec else ""
+        if not node_name:
+            return
+        slot = self._ensure_slot(node_name)
+        key = _pod_key(pod)
+        if sign > 0 and key in self._placed:
+            self._apply_pod(self._placed[key][0], -1)  # update = remove+add
+
+        # the shape memo collapses per-event parsing to one hit per template
+        # (node_name is excluded from the signature for exactly this)
+        shape = self._shape_of(pod)
+        self.used0[slot] += sign * shape["req4"].astype(np.float64)
+        self.used0_nonzero[slot] += sign * shape["nz2"].astype(np.float64)
+        self._touch("used0", "used0_nonzero")
+
+        if shape["port_cols"]:
+            for c in shape["port_cols"]:
+                self._ports_cnt[slot, c] += sign
+                self.node_ports0[slot, c] = 1 if self._ports_cnt[slot, c] > 0 else 0
+            self._touch("node_ports0")
+
+        self._apply_volumes(pod, slot, sign, shape)
+        self._apply_groups(pod, slot, sign)
+        self._apply_interpod(pod, slot, sign)
+
+        sig = _labels_sig(pod)
+        if sign > 0:
+            self._placed[key] = (pod, slot)
+            self._by_sig.setdefault(sig, {})[key] = slot
+            self._slot_pods[slot] = self._slot_pods.get(slot, 0) + 1
+            if pod.metadata and pod.metadata.deletion_timestamp:
+                self._terminating.add(key)
+        else:
+            self._placed.pop(key, None)
+            self._terminating.discard(key)
+            grp = self._by_sig.get(sig)
+            if grp is not None:
+                grp.pop(key, None)
+                if not grp:
+                    del self._by_sig[sig]
+            self._slot_pods[slot] = max(self._slot_pods.get(slot, 0) - 1, 0)
+
+    # --- volumes (NoDiskConflict / MaxPDVolumeCount occupancy) ---------------
+
+    def _disk_cols(self, pod: api.Pod):
+        out = []
+        for v in (pod.spec.volumes or []) if pod.spec else []:
+            if v.gce_persistent_disk:
+                out.append((("gce", v.gce_persistent_disk.pd_name),
+                            not v.gce_persistent_disk.read_only))
+            if v.aws_elastic_block_store:
+                out.append((("ebs", v.aws_elastic_block_store.volume_id), True))
+            if v.rbd:
+                for mon in (v.rbd.monitors or []):
+                    out.append((("rbd", v.rbd.pool, v.rbd.image, mon), True))
+        return out
+
+    def _volume_checkers(self):
+        ck = getattr(self, "_checkers", None)
+        if ck is None:
+            from kubernetes_tpu.scheduler.predicates import (
+                MaxPDVolumeCountChecker,
+            )
+            args = self.args
+            pvc = getattr(args, "pvc_lookup", None) if args else None
+            pv = getattr(args, "pv_lookup", None) if args else None
+            ck = self._checkers = (MaxPDVolumeCountChecker("ebs", 0, pvc, pv),
+                                   MaxPDVolumeCountChecker("gce-pd", 0, pvc, pv))
+        return ck
+
+    def _apply_volumes(self, pod: api.Pod, slot: int, sign: int, shape: dict):
+        if not (shape["disk_pairs"] or shape["direct_ebs"]
+                or shape["direct_gce"] or shape["has_pvc"]):
+            return
+        for c, rw in shape["disk_pairs"]:
+            self._disk_any_cnt[slot, c] += sign
+            self.node_disk_any0[slot, c] = 1 if self._disk_any_cnt[slot, c] > 0 else 0
+            if rw:
+                self._disk_rw_cnt[slot, c] += sign
+                self.node_disk_rw0[slot, c] = 1 if self._disk_rw_cnt[slot, c] > 0 else 0
+        ecols = list(shape["direct_ebs"])
+        gcols = list(shape["direct_gce"])
+        if shape["has_pvc"]:
+            ns = pod.metadata.namespace if pod.metadata else ""
+            _z, _b, pe, pg = self._pvc_info(ns, shape["claims"], {})
+            ecols += pe
+            gcols += pg
+        for c in ecols:
+            self._ebs_cnt[slot, c] += sign
+            self.node_ebs0[slot, c] = 1 if self._ebs_cnt[slot, c] > 0 else 0
+        for c in gcols:
+            self._gce_cnt[slot, c] += sign
+            self.node_gce0[slot, c] = 1 if self._gce_cnt[slot, c] > 0 else 0
+        self._touch("node_disk_any0", "node_disk_rw0", "node_ebs0", "node_gce0")
+
+    # --- spread groups --------------------------------------------------------
+
+    def _groups_of(self, ns: str, lbls: dict) -> List[int]:
+        out = []
+        for g, (gns, sels) in enumerate(self._group_rows):
+            if gns == ns and any(s.matches(lbls) for s in sels):
+                out.append(g)
+        return out
+
+    def _apply_groups(self, pod: api.Pod, slot: int, sign: int):
+        if pod.metadata and pod.metadata.deletion_timestamp:
+            return  # terminating pods don't count toward spread
+        if not self._group_rows:
+            return
+        ns = pod.metadata.namespace if pod.metadata else ""
+        for g in self._groups_of(ns, _labels_of(pod)):
+            self.group_counts0[slot, g] += sign
+        self._touch("group_counts0")
+
+    def _register_group(self, ns: str, sels: list, sig) -> int:
+        """New spread group: column + counts initialized from placed pods."""
+        gid = self._groupv.id(sig)
+        self._group_rows.append((ns, sels))
+        need = _pad(len(self._group_rows), SUB)
+        if self.group_counts0.shape[1] < need:
+            self.group_counts0 = _grow(
+                self.group_counts0, (self.n_cap, need))
+        for (pns, plbls), members in self._by_sig.items():
+            if pns != ns or not any(s.matches(dict(plbls)) for s in sels):
+                continue
+            live = [s for k, s in members.items() if k not in self._terminating]
+            if live:
+                np.add.at(self.group_counts0[:, gid],
+                          np.asarray(live, np.int64), 1)
+        self._touch("group_counts0")
+        return gid
+
+    # --- inter-pod affinity term machinery ------------------------------------
+
+    def _pod_terms(self, pod: api.Pod, kind: str):
+        aff = pod.spec.affinity if pod.spec else None
+        if aff is None:
+            return []
+        if kind == "aff":
+            src = aff.pod_affinity
+            return (src.required_during_scheduling_ignored_during_execution
+                    or []) if src else []
+        if kind == "anti":
+            src = aff.pod_anti_affinity
+            return (src.required_during_scheduling_ignored_during_execution
+                    or []) if src else []
+        out = []
+        if aff.pod_affinity:
+            for wt in (aff.pod_affinity.
+                       preferred_during_scheduling_ignored_during_execution or []):
+                if wt.weight and wt.pod_affinity_term:
+                    out.append((wt.pod_affinity_term, float(wt.weight)))
+        if aff.pod_anti_affinity:
+            for wt in (aff.pod_anti_affinity.
+                       preferred_during_scheduling_ignored_during_execution or []):
+                if wt.weight and wt.pod_affinity_term:
+                    out.append((wt.pod_affinity_term, -float(wt.weight)))
+        return out
+
+    def _term_parts(self, owner: api.Pod, term, weight=None):
+        from kubernetes_tpu.scheduler.predicates import _term_namespaces
+        names = _term_namespaces(owner, term)
+        sel = labelsel.selector_from_label_selector(term.label_selector)
+        if term.topology_key:
+            kids = [self._register_topo_key(term.topology_key)]
+        else:
+            kids = [self._keyv.get(k) for k in self.failure_domains]
+        key = (frozenset(names) if names is not None else "*",
+               str(sel), term.topology_key or "", weight)
+        return key, names, sel, kids
+
+    def _add_term(self, table: _TermTable, owner: api.Pod, term,
+                  weight=None) -> int:
+        """Register a pending-owned term; a NEW row's hit counts are
+        initialized from all placed pods (grouped by labels signature, so
+        the scan is per distinct shape, not per pod)."""
+        key, names, sel, kids = self._term_parts(owner, term, weight)
+        tid, fresh = table.add(key, names, sel, kids, weight)
+        if not fresh:
+            return tid
+        for (pns, plbls), members in self._by_sig.items():
+            if names is not None and pns not in names:
+                continue
+            if not sel.matches(dict(plbls)):
+                continue
+            table.totals[tid] += len(members)
+            if len(kids) == 1 and kids[0] is not None:
+                # single topology key: exact via bincount + domain gather
+                row = self.node_dom[kids[0]]
+                idx = np.fromiter(members.values(), np.int64, len(members))
+                doms = row[idx]
+                doms = doms[doms >= 0]
+                if doms.size:
+                    n_dom = int(row.max()) + 1
+                    per_dom = np.bincount(doms, minlength=n_dom)
+                    valid = row >= 0
+                    add = np.zeros(self.n_cap, table.hits.dtype)
+                    add[valid] = per_dom[row[valid]]
+                    table.hits[tid] += add
+            else:
+                for s in members.values():
+                    table.hits[tid] += self._domain_mask(s, [k for k in kids
+                                                             if k is not None])
+        return tid
+
+    def _apply_interpod(self, pod: api.Pod, slot: int, sign: int):
+        ns = pod.metadata.namespace if pod.metadata else ""
+        lbls = _labels_of(pod)
+
+        # 1) this placed pod matches pending-owned term rows -> hit counts
+        touched = []
+        for name, table in (("req_hit0", self.req_t),
+                            ("anti_hit0", self.anti_t),
+                            ("pref_hit0", self.pref_t)):
+            for tid in range(len(table.rows)):
+                if table.matches(tid, ns, lbls):
+                    kids = [k for k in table.rows[tid][2] if k is not None]
+                    table.hits[tid] += sign * self._domain_mask(slot, kids)
+                    table.totals[tid] += sign
+                    touched.append(name)
+
+        # 2) this placed pod's own terms -> sym (hard anti) and te (reverse
+        # preferred + reverse-hard) tables
+        hw = float(self.args.hard_pod_affinity_weight
+                   if self.args is not None else 1)
+        for term in self._pod_terms(pod, "anti"):
+            key, names, sel, kids = self._term_parts(pod, term)
+            tid, _ = self.sym_t.add(key, names, sel, kids)
+            kids = [k for k in kids if k is not None]
+            self.sym_t.hits[tid] += sign * self._domain_mask(slot, kids)
+            touched.append("sym_dom0")
+        if hw > 0:
+            for term in self._pod_terms(pod, "aff"):
+                key, names, sel, kids = self._term_parts(pod, term, ("hard",))
+                tid, _ = self.te_t.add(key, names, sel, kids, ("hard",))
+                kids = [k for k in kids if k is not None]
+                self.te_t.hits[tid] += sign * hw * self._domain_mask(slot, kids)
+                touched.append("te_dom0")
+        for term, w in self._pod_terms(pod, "pref"):
+            key, names, sel, kids = self._term_parts(pod, term, w)
+            tid, _ = self.te_t.add(key, names, sel, kids, w)
+            kids = [k for k in kids if k is not None]
+            self.te_t.hits[tid] += sign * w * self._domain_mask(slot, kids)
+            touched.append("te_dom0")
+        if touched:
+            self._touch(*set(touched))
+
+    def _reinit_interpod(self):
+        """Re-derive every placed contribution to the hit tables (node
+        topology changed under them)."""
+        for t in (self.req_t, self.anti_t, self.pref_t, self.sym_t, self.te_t):
+            t.hits[:] = 0
+            t.totals[:] = 0
+        for pod, slot in self._placed.values():
+            self._apply_interpod(pod, slot, +1)
+        self._touch("req_hit0", "anti_hit0", "pref_hit0", "sym_dom0",
+                    "te_dom0")
+
+    # --- node-affinity registration ------------------------------------------
+
+    def _expr_id(self, e: api.NodeSelectorRequirement) -> int:
+        key = (e.key, e.operator, tuple(e.values or ()))
+        i = self._exprv.get(key)
+        if i is not None:
+            return i
+        i = self._exprv.id(key)
+        req = labelsel.Requirement(e.key, e.operator, tuple(e.values or ()))
+        self._expr_reqs.append(req)
+        need = _pad(len(self._expr_reqs), SUB)
+        if self.expr_node.shape[0] < need:
+            self.expr_node = _grow(self.expr_node, (need, self.n_cap))
+        for slot, lbls in self._node_labels_d.items():
+            if req.matches(lbls):
+                self.expr_node[i, slot] = 1
+        self._touch("expr_node")
+        return i
+
+    def _term_id(self, t: api.NodeSelectorTerm) -> int:
+        eids = tuple(sorted(self._expr_id(e)
+                            for e in (t.match_expressions or [])))
+        i = self._termv.get(eids)
+        if i is None:
+            i = self._termv.id(eids)
+            self._term_exprs.append(list(eids))
+        return i
+
+    def _pref_entry_id(self, tid: int, w: float) -> int:
+        key = (tid, w)
+        i = self._prefv.get(key)
+        if i is not None:
+            return i
+        i = self._prefv.id(key)
+        self._pref_entries.append((tid, w))
+        need = _pad(len(self._pref_entries), SUB)
+        if self.pref_term_node.shape[0] < need:
+            self.pref_term_node = _grow(self.pref_term_node,
+                                        (need, self.n_cap))
+            self.pref_weight = _grow(self.pref_weight, (need,))
+        eids = self._term_exprs[tid]
+        for slot in self._node_labels_d:
+            self.pref_term_node[i, slot] = (
+                1 if all(self.expr_node[e, slot] for e in eids) else 0)
+        self.pref_weight[i] = w
+        self._touch("pref_term_node", "pref_weight")
+        return i
+
+    def _image_id(self, name: str) -> int:
+        iid = self._imagev.get(name)
+        if iid is not None:
+            return iid
+        iid = self._imagev.id(name)
+        self._grow_cols("image_node_sizes", self._imagev)
+        for slot, imgs in self._node_images_d.items():
+            mib = imgs.get(name)
+            if mib:
+                self.image_node_sizes[slot, iid] = mib
+        self._touch("image_node_sizes")
+        return iid
+
+    # --- pod shapes (cross-batch memo of pure spec derivations) ---------------
+
+    @staticmethod
+    def _selector_sig(ls: Optional[api.LabelSelector]):
+        if ls is None:
+            return None
+        return (tuple(sorted((ls.match_labels or {}).items())),
+                tuple((r.key, r.operator, tuple(r.values or ()))
+                      for r in (ls.match_expressions or [])))
+
+    def _aff_sig(self, aff: Optional[api.Affinity]):
+        if aff is None:
+            return None
+
+        def pterm(t):
+            return (tuple(t.namespaces or ()), self._selector_sig(t.label_selector),
+                    t.topology_key or "")
+
+        def nterm(t):
+            return tuple((e.key, e.operator, tuple(e.values or ()))
+                         for e in (t.match_expressions or []))
+
+        na = pa = an = None
+        if aff.node_affinity:
+            req = aff.node_affinity.required_during_scheduling_ignored_during_execution
+            na = (tuple(nterm(t) for t in (req.node_selector_terms or []))
+                  if req is not None else None,
+                  tuple((p.weight, nterm(p.preference))
+                        for p in (aff.node_affinity.
+                                  preferred_during_scheduling_ignored_during_execution or [])
+                        if p.preference is not None))
+        if aff.pod_affinity:
+            pa = (tuple(pterm(t) for t in (
+                      aff.pod_affinity.required_during_scheduling_ignored_during_execution or [])),
+                  tuple((w.weight, pterm(w.pod_affinity_term))
+                        for w in (aff.pod_affinity.
+                                  preferred_during_scheduling_ignored_during_execution or [])
+                        if w.pod_affinity_term))
+        if aff.pod_anti_affinity:
+            an = (tuple(pterm(t) for t in (
+                      aff.pod_anti_affinity.required_during_scheduling_ignored_during_execution or [])),
+                  tuple((w.weight, pterm(w.pod_affinity_term))
+                        for w in (aff.pod_anti_affinity.
+                                  preferred_during_scheduling_ignored_during_execution or [])
+                        if w.pod_affinity_term))
+        return (na, pa, an)
+
+    def _spec_sig(self, pod: api.Pod):
+        s = pod.spec
+        if s is None:
+            return ()
+        conts = tuple(
+            (c.image or "",
+             tuple(sorted((c.resources.requests or {}).items()))
+             if c.resources and c.resources.requests else (),
+             bool(c.resources and (c.resources.requests or c.resources.limits)),
+             tuple((p.protocol or "TCP", p.host_port)
+                   for p in (c.ports or []) if p.host_port))
+            for c in (s.containers or []))
+        tols = tuple((t.key, t.operator, t.value, t.effect)
+                     for t in (s.tolerations or []))
+        vols = tuple(
+            (v.name,
+             (v.gce_persistent_disk.pd_name, v.gce_persistent_disk.read_only)
+             if v.gce_persistent_disk else None,
+             v.aws_elastic_block_store.volume_id
+             if v.aws_elastic_block_store else None,
+             (v.rbd.pool, v.rbd.image, tuple(v.rbd.monitors or ()))
+             if v.rbd else None,
+             v.persistent_volume_claim.claim_name
+             if v.persistent_volume_claim else None)
+            for v in (s.volumes or []))
+        # node_name is deliberately NOT in the signature: placed pods from
+        # one template then share the shape entry (host_req is derived per
+        # pod in build())
+        return (conts, tols, tuple(sorted((s.node_selector or {}).items())),
+                vols, self._aff_sig(s.affinity),
+                pod.metadata.namespace if pod.metadata else "")
+
+    def _shape_of(self, pod: api.Pod) -> dict:
+        sig = self._spec_sig(pod)
+        shape = self._shape_memo.get(sig)
+        if shape is None:
+            if len(self._shape_memo) > 100_000:
+                self._shape_memo.clear()
+            shape = self._shape_memo[sig] = self._build_shape(pod)
+        return shape
+
+    def _build_shape(self, pod: api.Pod) -> dict:
+        """Everything derivable from the spec alone, vocab ids resolved."""
+        s = pod.spec
+        rq, nz = _pod_req_vec(pod)
+        sel_cols = [self._labelv.id(kv)
+                    for kv in ((s.node_selector or {}) if s else {}).items()]
+        self._grow_cols("node_labels", self._labelv)
+        port_cols = []
+        for pp in _pod_ports_set(pod):
+            self._portv.id(pp)
+            self._grow_cols("node_ports0", self._portv, extra=("_ports_cnt",))
+            port_cols.append(self._portv.get(pp))
+        image_cols = [self._image_id(c.image)
+                      for c in ((s.containers or []) if s else []) if c.image]
+
+        # node affinity
+        aff = s.affinity if s else None
+        na = aff.node_affinity if aff else None
+        req = na.required_during_scheduling_ignored_during_execution if na else None
+        term_ids = ([self._term_id(t) for t in (req.node_selector_terms or [])]
+                    if req is not None else None)
+        pref_pairs: Dict[int, int] = {}
+        for p in ((na.preferred_during_scheduling_ignored_during_execution or [])
+                  if na else []):
+            if p.weight and p.preference is not None:
+                pid = self._pref_entry_id(self._term_id(p.preference),
+                                          float(p.weight))
+                pref_pairs[pid] = pref_pairs.get(pid, 0) + 1
+
+        # inter-pod terms owned by this (pending) shape
+        req_tids = [self._add_term(self.req_t, pod, t)
+                    for t in self._pod_terms(pod, "aff")]
+        anti_tids = [self._add_term(self.anti_t, pod, t)
+                     for t in self._pod_terms(pod, "anti")]
+        pref_tids = [(self._add_term(self.pref_t, pod, t, w), w)
+                     for t, w in self._pod_terms(pod, "pref")]
+        if req_tids or anti_tids or pref_tids:
+            self._touch("req_hit0", "anti_hit0", "pref_hit0")
+
+        # direct (non-PVC) volume columns; PVC-backed resolve per batch
+        disk_pairs = []
+        for ck, rw in self._disk_cols(pod):
+            self._diskv.id(ck)
+            self._grow_cols("node_disk_any0", self._diskv,
+                            extra=("node_disk_rw0", "_disk_any_cnt",
+                                   "_disk_rw_cnt"))
+            disk_pairs.append((self._diskv.get(ck), rw))
+        ebs_ck, gce_ck = self._volume_checkers()
+        direct_ebs, direct_gce, has_pvc = [], [], False
+        for v in ((s.volumes or []) if s else []):
+            if v.persistent_volume_claim:
+                has_pvc = True
+                continue
+            vid = ebs_ck._volume_id(v, "")
+            if vid is not None:
+                self._ebsv.id(vid)
+                self._grow_cols("node_ebs0", self._ebsv, extra=("_ebs_cnt",))
+                direct_ebs.append(self._ebsv.get(vid))
+            vid = gce_ck._volume_id(v, "")
+            if vid is not None:
+                self._gcev.id(vid)
+                self._grow_cols("node_gce0", self._gcev, extra=("_gce_cnt",))
+                direct_gce.append(self._gcev.get(vid))
+
+        return {
+            "req4": rq, "nz2": nz, "best_effort": _is_best_effort(pod),
+            "sel_cols": sel_cols, "port_cols": port_cols,
+            "image_cols": image_cols,
+            "tols": list((s.tolerations or []) if s else []),
+            "tol_ns": [], "tol_pref": [], "tol_upto": 0,
+            "term_ids": term_ids, "pref_pairs": pref_pairs,
+            "req_tids": req_tids, "anti_tids": anti_tids,
+            "pref_tids": pref_tids,
+            "disk_pairs": disk_pairs, "direct_ebs": direct_ebs,
+            "direct_gce": direct_gce, "has_pvc": has_pvc,
+            "claims": [v.persistent_volume_claim.claim_name
+                       for v in ((s.volumes or []) if s else [])
+                       if v.persistent_volume_claim],
+        }
+
+    def _tol_cols(self, shape: dict):
+        """Lazily extend a shape's tolerated-taint columns as the taint
+        vocabulary grows (kills the O(P×T) per-batch double loop)."""
+        tv = len(self._taintv)
+        if shape["tol_upto"] < tv and shape["tols"]:
+            items = list(self._taintv.items())[shape["tol_upto"]:]
+            for (tk, tval, teff), tid in items:
+                t = api.Taint(key=tk, value=tval, effect=teff)
+                for tol in shape["tols"]:
+                    if tol.tolerates(t):
+                        if teff == api.TAINT_NO_SCHEDULE:
+                            shape["tol_ns"].append(tid)
+                        elif teff == api.TAINT_PREFER_NO_SCHEDULE:
+                            shape["tol_pref"].append(tid)
+                        break
+        shape["tol_upto"] = tv
+        return shape["tol_ns"], shape["tol_pref"]
+
+    def _match_ids(self, table_name: str, table: _TermTable, ns: str,
+                   lbls_sig) -> List[int]:
+        """Term rows matching a pending pod's (ns, labels), memoized with
+        lazy extension as tables grow."""
+        mkey = (table_name, ns, lbls_sig)
+        m = self._match_memo.get(mkey)
+        if m is None:
+            if len(self._match_memo) > 300_000:
+                self._match_memo.clear()
+            m = self._match_memo[mkey] = {"ids": [], "upto": 0}
+        if m["upto"] < len(table.rows):
+            lbls = dict(lbls_sig)
+            for tid in range(m["upto"], len(table.rows)):
+                if table.matches(tid, ns, lbls):
+                    m["ids"].append(tid)
+            m["upto"] = len(table.rows)
+        return m["ids"]
+
+    # --- per-batch PVC resolution ---------------------------------------------
+
+    def _pvc_info(self, ns: str, claims: List[str], memo: dict):
+        """(zone label ids, broken) for a pod's claims — per-batch memo (the
+        PV/PVC listers are live state, never cached across batches)."""
+        key = (ns, tuple(claims))
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        args = self.args
+        if args is None or not getattr(args, "pvc_lookup", None) \
+                or not getattr(args, "pv_lookup", None):
+            memo[key] = ([], False, [], [])
+            return memo[key]
+        zone_cols, broken, ebs_cols, gce_cols = [], False, [], []
+        ebs_ck, gce_ck = self._volume_checkers()
+        for claim in claims:
+            pvc = args.pvc_lookup(ns, claim)
+            if pvc is None or not (pvc.spec and pvc.spec.volume_name):
+                broken = True
+                continue
+            pv = args.pv_lookup(pvc.spec.volume_name)
+            if pv is None:
+                broken = True
+                continue
+            pv_labels = (pv.metadata.labels or {}) if pv.metadata else {}
+            for lk in (api.LABEL_ZONE, api.LABEL_REGION):
+                want = pv_labels.get(lk)
+                if want:
+                    zone_cols.append(self._labelv.id((lk, want)))
+            v = api.Volume(name=claim,
+                           persistent_volume_claim=api.
+                           PersistentVolumeClaimVolumeSource(claim_name=claim))
+            vid = ebs_ck._volume_id(v, ns)
+            if vid is not None:
+                self._ebsv.id(vid)
+                self._grow_cols("node_ebs0", self._ebsv, extra=("_ebs_cnt",))
+                ebs_cols.append(self._ebsv.get(vid))
+            vid = gce_ck._volume_id(v, ns)
+            if vid is not None:
+                self._gcev.id(vid)
+                self._grow_cols("node_gce0", self._gcev, extra=("_gce_cnt",))
+                gce_cols.append(self._gcev.get(vid))
+        memo[key] = (zone_cols, broken, ebs_cols, gce_cols)
+        return memo[key]
+
+    # --- spread-group derivation (per batch; listers are live) ----------------
+
+    def _pod_selectors(self, pod: api.Pod):
+        args = self.args
+        if args is None:
+            return []
+        sels = []
+        if args.service_lister:
+            for svc in args.service_lister.get_pod_services(pod):
+                sels.append(labelsel.selector_from_map(svc.spec.selector))
+        if args.controller_lister:
+            for rc in args.controller_lister.get_pod_controllers(pod):
+                sels.append(labelsel.selector_from_map(rc.spec.selector))
+        if args.replicaset_lister:
+            for rs in args.replicaset_lister.get_pod_replica_sets(pod):
+                sels.append(labelsel.selector_from_label_selector(rs.spec.selector))
+        return sels
+
+    # --- batch build ----------------------------------------------------------
+
+    def build(self, pending: List[api.Pod]) -> ClusterTensors:
+        import time as _t
+        if self.broken:
+            raise RuntimeError(f"incremental mirror broken: {self.broken}")
+        t0 = _t.perf_counter()
+        with self._lock:
+            ct = self._build_locked(pending)
+        self.builds += 1
+        self.last_build_seconds = _t.perf_counter() - t0
+        return ct
+
+    def _build_locked(self, pending: List[api.Pod]) -> ClusterTensors:
+        P = len(pending)
+        Pp = _bucket(P)
+        shapes = [self._shape_of(pod) for pod in pending]
+
+        # pass 1: group registration per distinct (ns, labels) signature
+        group_memo: Dict[tuple, Tuple[int, List[int]]] = {}
+        pvc_memo: dict = {}
+        for pod in pending:
+            sig = _labels_sig(pod)
+            if sig in group_memo:
+                continue
+            sels = self._pod_selectors(pod)
+            gid = -1
+            if sels:
+                gsig = _selector_signature(sels, sig[0])
+                gid = self._groupv.get(gsig)
+                if gid is None:
+                    gid = self._register_group(sig[0], sels, gsig)
+            group_memo[sig] = (gid, [])
+        # pass 2: membership across ALL registered groups
+        member_memo: Dict[tuple, List[int]] = {}
+        for sig in group_memo:
+            lbls = dict(sig[1])
+            member_memo[sig] = [g for g, (gns, sels)
+                                in enumerate(self._group_rows)
+                                if gns == sig[0]
+                                and any(s.matches(lbls) for s in sels)]
+
+        # pass 3: PVC resolution registers label/volume columns — run it
+        # before the column widths below are frozen
+        for pod, shape in zip(pending, shapes):
+            if shape["has_pvc"]:
+                self._pvc_info(pod.metadata.namespace if pod.metadata else "",
+                               shape["claims"], pvc_memo)
+        self._grow_cols("node_labels", self._labelv)
+
+        N = self.n_cap
+        G = self.group_counts0.shape[1]
+        L = self.node_labels.shape[1]
+        T = self.taints_nosched.shape[1]
+        PT = self.node_ports0.shape[1]
+        I = self.image_node_sizes.shape[1]
+        TM = _pad(len(self._term_exprs), SUB)
+        E = self.expr_node.shape[0]
+        PT2 = self.pref_term_node.shape[0]
+        TR = self.req_t.hits.shape[0]
+        TA = self.anti_t.hits.shape[0]
+        TP = self.pref_t.hits.shape[0]
+        TS = self.sym_t.hits.shape[0]
+        TE = self.te_t.hits.shape[0]
+        D = self.node_disk_any0.shape[1]
+        VE = self.node_ebs0.shape[1]
+        VG = self.node_gce0.shape[1]
+
+        req = np.zeros((Pp, 4), np.float32)
+        nonzero_req = np.zeros((Pp, 2), np.float32)
+        sel_required = np.zeros((Pp, L), np.int8)
+        sel_count = np.zeros(Pp, np.float32)
+        pod_ports = np.zeros((Pp, PT), np.int8)
+        tol_ns = np.zeros((Pp, T), np.int8)
+        tol_pref = np.zeros((Pp, T), np.int8)
+        best_effort = np.zeros(Pp, bool)
+        host_req = np.full(Pp, -1, np.int32)
+        pod_valid = np.zeros(Pp, bool)
+        pod_images = np.zeros((Pp, I), np.int8)
+        pod_term = np.zeros((Pp, TM), np.int8)
+        pod_has_aff = np.zeros(Pp, bool)
+        pod_pref_term = np.zeros((Pp, PT2), np.float32)
+        pod_group = np.full(Pp, -1, np.int32)
+        pod_in_group = np.zeros((Pp, G), np.int8)
+        req_own = np.zeros((Pp, TR), np.float32)
+        anti_own = np.zeros((Pp, TA), np.float32)
+        pref_own = np.zeros((Pp, TP), np.float32)
+        req_match = np.zeros((TR, Pp), np.int8)
+        anti_match = np.zeros((TA, Pp), np.int8)
+        pref_match = np.zeros((TP, Pp), np.int8)
+        sym_match = np.zeros((TS, Pp), np.int8)
+        te_match = np.zeros((TE, Pp), np.int8)
+        pod_disk_any = np.zeros((Pp, D), np.int8)
+        pod_disk_rw = np.zeros((Pp, D), np.int8)
+        pod_ebs = np.zeros((Pp, VE), np.int8)
+        pod_gce = np.zeros((Pp, VG), np.int8)
+
+        for p, (pod, shape) in enumerate(zip(pending, shapes)):
+            pod_valid[p] = True
+            req[p] = shape["req4"]
+            nonzero_req[p] = shape["nz2"]
+            best_effort[p] = shape["best_effort"]
+            for c in shape["sel_cols"]:
+                sel_required[p, c] = 1
+            for c in shape["port_cols"]:
+                pod_ports[p, c] = 1
+            for c in shape["image_cols"]:
+                pod_images[p, c] = 1
+            tns, tpf = self._tol_cols(shape)
+            for c in tns:
+                tol_ns[p, c] = 1
+            for c in tpf:
+                tol_pref[p, c] = 1
+            want = pod.spec.node_name if pod.spec else ""
+            if want:
+                host_req[p] = self._node_index.get(want, -2)
+            if shape["term_ids"] is not None:
+                pod_has_aff[p] = True
+                for t in shape["term_ids"]:
+                    pod_term[p, t] = 1
+            for pid, cnt in shape["pref_pairs"].items():
+                pod_pref_term[p, pid] = cnt
+            for t in shape["req_tids"]:
+                req_own[p, t] += 1.0
+            for t in shape["anti_tids"]:
+                anti_own[p, t] += 1.0
+            for t, _w in shape["pref_tids"]:
+                pref_own[p, t] += 1.0
+            for c, rw in shape["disk_pairs"]:
+                pod_disk_any[p, c] = 1
+                if rw:
+                    pod_disk_rw[p, c] = 1
+            for c in shape["direct_ebs"]:
+                pod_ebs[p, c] = 1
+            for c in shape["direct_gce"]:
+                pod_gce[p, c] = 1
+            sel_count[p] = len(set(shape["sel_cols"]))
+            if shape["has_pvc"]:
+                ns = pod.metadata.namespace if pod.metadata else ""
+                zcols, broken, ecols, gcols = self._pvc_info(
+                    ns, shape["claims"], pvc_memo)
+                extra = [c for c in zcols if not sel_required[p, c]]
+                for c in extra:
+                    sel_required[p, c] = 1
+                sel_count[p] += len(set(extra))
+                if broken:
+                    sel_count[p] += 1.0
+                for c in ecols:
+                    pod_ebs[p, c] = 1
+                for c in gcols:
+                    pod_gce[p, c] = 1
+
+            sig = _labels_sig(pod)
+            pod_group[p] = group_memo[sig][0]
+            for g in member_memo[sig]:
+                pod_in_group[p, g] = 1
+            ns, lsig = sig
+            for t in self._match_ids("req", self.req_t, ns, lsig):
+                req_match[t, p] = 1
+            for t in self._match_ids("anti", self.anti_t, ns, lsig):
+                anti_match[t, p] = 1
+            for t in self._match_ids("pref", self.pref_t, ns, lsig):
+                pref_match[t, p] = 1
+            for t in self._match_ids("sym", self.sym_t, ns, lsig):
+                sym_match[t, p] = 1
+            for t in self._match_ids("te", self.te_t, ns, lsig):
+                te_match[t, p] = 1
+
+        # small derived tables (fresh each batch; cheap)
+        term_expr = np.zeros((TM, E), np.float32)
+        term_count = np.zeros(TM, np.float32)
+        for i, eids in enumerate(self._term_exprs):
+            for e in eids:
+                term_expr[i, e] = 1.0
+            term_count[i] = len(eids)
+
+        def topo(table: _TermTable, rows_pad: int):
+            K = self.node_dom.shape[0]
+            t = np.zeros((rows_pad, K), np.float32)
+            for i, (_n, _s, kids, _w) in enumerate(table.rows):
+                for kid in kids:
+                    if kid is not None:
+                        t[i, kid] = 1.0
+            return t
+
+        pref_w = np.zeros(TP, np.float32)
+        for i, (_n, _s, _k, w) in enumerate(self.pref_t.rows):
+            pref_w[i] = w
+
+        hw = float(self.args.hard_pod_affinity_weight
+                   if self.args is not None else 1)
+        from kubernetes_tpu.scheduler.predicates import (
+            DEFAULT_MAX_EBS_VOLUMES, DEFAULT_MAX_GCE_PD_VOLUMES,
+        )
+        return ClusterTensors(
+            node_names=list(self._node_names),
+            pod_keys=[_pod_key(p) for p in pending],
+            alloc=self.alloc, used0=self.used0,
+            used0_nonzero=self.used0_nonzero,
+            node_labels=self.node_labels, node_ports0=self.node_ports0,
+            taints_nosched=self.taints_nosched,
+            taints_prefer=self.taints_prefer,
+            mem_pressure=self.mem_pressure, node_valid=self.node_valid,
+            zone_id=self.zone_id, n_zones=max(len(self._zonev), 1),
+            req=req, nonzero_req=nonzero_req,
+            sel_required=sel_required, sel_count=sel_count,
+            pod_ports=pod_ports, tol_nosched=tol_ns, tol_prefer=tol_pref,
+            best_effort=best_effort, host_req=host_req, pod_valid=pod_valid,
+            expr_node=self.expr_node, term_expr=term_expr,
+            term_expr_count=term_count, pod_term=pod_term,
+            pod_has_affinity=pod_has_aff,
+            pref_term_node=self.pref_term_node, pref_weight=self.pref_weight,
+            pod_pref_term=pod_pref_term,
+            pod_group=pod_group, pod_in_group=pod_in_group,
+            group_counts0=self.group_counts0,
+            n_groups=max(len(self._group_rows), 1),
+            image_node_sizes=self.image_node_sizes, pod_images=pod_images,
+            node_dom=self.node_dom,
+            req_topo=topo(self.req_t, TR), req_own=req_own,
+            req_match=req_match, req_hit0=self.req_t.hits,
+            req_nomatch0=(self.req_t.totals == 0),
+            anti_topo=topo(self.anti_t, TA), anti_own=anti_own,
+            anti_match=anti_match, anti_hit0=self.anti_t.hits,
+            pref_topo=topo(self.pref_t, TP), pref_own=pref_own,
+            pref_match=pref_match, pref_w=pref_w,
+            pref_hit0=self.pref_t.hits,
+            sym_dom0=self.sym_t.hits, sym_match=sym_match,
+            te_dom0=self.te_t.hits, te_match=te_match,
+            hard_weight=np.asarray(hw, np.float32),
+            pod_disk_any=pod_disk_any, pod_disk_rw=pod_disk_rw,
+            node_disk_any0=self.node_disk_any0,
+            node_disk_rw0=self.node_disk_rw0,
+            pod_ebs=pod_ebs, node_ebs0=self.node_ebs0,
+            pod_gce=pod_gce, node_gce0=self.node_gce0,
+            max_ebs=np.asarray(DEFAULT_MAX_EBS_VOLUMES, np.float32),
+            max_gce=np.asarray(DEFAULT_MAX_GCE_PD_VOLUMES, np.float32),
+            n_real_nodes=self._hi, n_real_pods=P,
+        )
+
+    # --- device residency -----------------------------------------------------
+
+    # node-side fields whose device copies survive across batches (everything
+    # else is pod-side / derived-fresh and re-uploads every batch)
+    _NODE_SIDE = frozenset((
+        "alloc", "used0", "used0_nonzero", "node_labels", "node_ports0",
+        "taints_nosched", "taints_prefer", "mem_pressure", "node_valid",
+        "zone_id", "image_node_sizes", "node_dom", "group_counts0",
+        "expr_node", "pref_term_node", "pref_weight", "req_hit0", "anti_hit0",
+        "pref_hit0", "sym_dom0", "te_dom0", "node_disk_any0", "node_disk_rw0",
+        "node_ebs0", "node_gce0",
+    ))
+
+    def device_sync(self, ct: ClusterTensors, device=None):
+        """jax-array view of the batch: node-side arrays re-upload only when
+        their version bumped since the last sync (double-buffered on device —
+        the previous batch's buffers stay alive until replaced)."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_dev_cache"):
+            self._dev_cache: Dict[str, Tuple[int, object]] = {}
+        out = {}
+        uploaded = 0
+        for k, v in ct.arrays().items():
+            if v.dtype == np.float64:
+                v = v.astype(np.float32)
+            if k in self._NODE_SIDE:
+                ver = self._versions.get(k, 0)
+                hit = self._dev_cache.get(k)
+                if hit is not None and hit[0] == ver:
+                    out[k] = hit[1]
+                    continue
+                arr = jnp.asarray(v)
+                if device is not None:
+                    arr = jax.device_put(arr, device)
+                self._dev_cache[k] = (ver, arr)
+                out[k] = arr
+                uploaded += v.nbytes
+            else:
+                arr = jnp.asarray(v)
+                if device is not None:
+                    arr = jax.device_put(arr, device)
+                out[k] = arr
+                uploaded += v.nbytes
+        self.last_upload_bytes = uploaded
+        return out
+
+    # --- the full incremental decision path -----------------------------------
+
+    def schedule(self, pending: List[api.Pod], weights=None,
+                 device=None) -> List[Optional[str]]:
+        """build + device sync + kernel; returns node name (or None) per
+        pending pod, FIFO order — drop-in for scheduler.batch.tpu_batch."""
+        from kubernetes_tpu.ops.kernel import (
+            Weights, _schedule_jit, features_of,
+        )
+        weights = weights or Weights()
+        with self._lock:
+            ct = self.build(pending)
+            arrays = self.device_sync(ct, device=device)
+            n_zones, feats = ct.n_zones, features_of(ct)
+        out = np.asarray(_schedule_jit(arrays, n_zones, weights, feats))
+        result: List[Optional[str]] = []
+        for i in range(ct.n_real_pods):
+            n = int(out[i])
+            name = ct.node_names[n] if 0 <= n < len(ct.node_names) else ""
+            result.append(name or None)
+        return result

@@ -440,10 +440,23 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
 
 # --- public API ---------------------------------------------------------------
 
+# integer fields that stay integral on device (indices, not indicators)
+_INT_FIELDS = frozenset(("zone_id", "host_req", "node_dom", "pod_group"))
+
+
 @functools.partial(jax.jit, static_argnames=("n_zones", "weights", "feats"))
 def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
                   feats: Features):
-    t = dict(tensors)
+    # indicator/count matrices may arrive packed (int8/int16/int32 — 4x less
+    # upload traffic than f32, ops/incremental.py); widen on-device where
+    # the MXU wants floats. XLA fuses the casts into the consumers.
+    t = {}
+    for k, v in tensors.items():
+        if (k in _INT_FIELDS or v.dtype == jnp.bool_
+                or jnp.issubdtype(v.dtype, jnp.floating)):
+            t[k] = v
+        else:
+            t[k] = v.astype(jnp.float32)
     t["n_zones"] = n_zones
     s = static_pass(t)
     return greedy_commit(t, s, weights, feats)

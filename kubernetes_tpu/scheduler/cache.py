@@ -15,6 +15,7 @@ deterministically.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time as _time
 from typing import Callable, Dict, List, Optional
@@ -28,6 +29,8 @@ from kubernetes_tpu.client.cache import meta_namespace_key
 # *something*; spreading math uses these floors.
 DEFAULT_MILLI_CPU_REQUEST = 100
 DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
+
+_log = logging.getLogger("scheduler.cache")
 
 
 class Resource:
@@ -155,6 +158,38 @@ class SchedulerCache:
         self._nodes: Dict[str, NodeInfo] = {}
         self._assumed: Dict[str, float] = {}   # pod key -> deadline (None=confirmed)
         self._pod_states: Dict[str, api.Pod] = {}  # key -> pod as last cached
+        self._listeners: List[object] = []
+
+    # --- delta listeners ------------------------------------------------------
+    #
+    # The incremental tensorizer (ops/incremental.py) mirrors this cache as
+    # device-ready arrays. Listeners get every placed-pod and node mutation
+    # *under the cache lock*, so they observe the exact serialized order of
+    # state changes — the delta stream that replaces the per-batch world
+    # rebuild (the cache.go:77-85 clone-per-decision anti-pattern).
+
+    def add_listener(self, listener) -> None:
+        """listener may implement pod_added(pod), pod_removed(pod),
+        node_added(node), node_updated(node), node_removed(node); pod events
+        fire only for pods with a node assignment (placed or assumed)."""
+        with self._lock:
+            self._listeners.append(listener)
+            for name, ni in self._nodes.items():
+                if ni.node is not None:
+                    _notify(listener, "node_added", ni.node)
+                for p in ni.pods:
+                    _notify(listener, "pod_added", p)
+
+    def remove_listener(self, listener) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(listener)
+            except ValueError:
+                pass
+
+    def _fire(self, event: str, obj) -> None:
+        for l in self._listeners:
+            _notify(l, event, obj)
 
     # --- pods ----------------------------------------------------------------
 
@@ -219,8 +254,11 @@ class SchedulerCache:
             ni = self._nodes.get(node.metadata.name)
             if ni is None:
                 ni = self._nodes[node.metadata.name] = NodeInfo(node)
+                self._fire("node_added", node)
             else:
+                fresh = ni.node is None
                 ni.node = node
+                self._fire("node_added" if fresh else "node_updated", node)
 
     def update_node(self, node: api.Node) -> None:
         self.add_node(node)
@@ -232,6 +270,7 @@ class SchedulerCache:
                 ni.node = None
                 if not ni.pods:
                     del self._nodes[node.metadata.name]
+                self._fire("node_removed", node)
 
     # --- reads ---------------------------------------------------------------
 
@@ -249,20 +288,37 @@ class SchedulerCache:
 
     def _add_locked(self, pod: api.Pod):
         node_name = pod.spec.node_name if pod.spec else ""
-        if node_name:
+        placed = bool(node_name)
+        if placed:
             ni = self._nodes.get(node_name)
             if ni is None:
                 # pod observed before its node: keep aggregates anyway
                 ni = self._nodes[node_name] = NodeInfo(None)
             ni.add_pod(pod)
+        # fire only after the cache mutation is complete, so a throwing
+        # listener can never leave a booked-but-untracked phantom pod
         self._pod_states[meta_namespace_key(pod)] = pod
+        if placed:
+            self._fire("pod_added", pod)
 
     def _remove_locked(self, pod: api.Pod):
         node_name = pod.spec.node_name if pod.spec else ""
         if node_name:
             ni = self._nodes.get(node_name)
             if ni is not None:
-                ni.remove_pod(pod)
+                if ni.remove_pod(pod):
+                    self._fire("pod_removed", pod)
                 if ni.node is None and not ni.pods:
                     del self._nodes[node_name]
         self._pod_states.pop(meta_namespace_key(pod), None)
+
+
+def _notify(listener, event: str, obj) -> None:
+    fn = getattr(listener, event, None)
+    if fn is None:
+        return
+    try:
+        fn(obj)
+    except Exception:  # a broken mirror must never corrupt the cache or
+        _log.exception("cache listener %s(%s) failed",  # drop a batch
+                       event, getattr(listener, "__class__", type(listener)).__name__)

@@ -106,10 +106,19 @@ class BatchScheduler(Scheduler):
                  bind_workers: int = 32, strict: bool = False,
                  degraded_after: int = 3, fail_after: int = 10,
                  retry_initial: float = 1.0, retry_max: float = 60.0,
-                 bug_cooldown: float = 300.0, clock=time.monotonic):
+                 bug_cooldown: float = 300.0, clock=time.monotonic,
+                 incremental: bool = True):
         super().__init__(factory, algorithm)
         self.batch_size = batch_size
         self.weights = weights or Weights()
+        # the incremental mirror replaces the per-batch world rebuild
+        # (SURVEY §7 hard part #2); it subscribes to cache deltas and keeps
+        # node-side tensors device-resident across batches
+        self._inc = None
+        if incremental:
+            from kubernetes_tpu.ops.incremental import IncrementalTensorizer
+            self._inc = IncrementalTensorizer(factory.plugin_args)
+            factory.cache.add_listener(self._inc)
         self.kernel_batches = 0     # successful device batches
         self.kernel_pods = 0        # pods placed via the device path
         self.kernel_failures = 0    # device/tensorize errors (fell back)
@@ -218,18 +227,21 @@ class BatchScheduler(Scheduler):
         # a warning, no health impact (the classifier must only ever see
         # exceptions from the tensorize/device path)
         try:
-            info = self.f.cache.get_node_name_to_info_map()
             nodes = self.f.node_lister.list()
             if not nodes:
                 for pod in pods:
                     self._handle_failure(pod, FitError(pod, {}))
                 return len(pods)
-            node_set = {n.metadata.name for n in nodes}
-            # every cached pod (incl. assumed ones from previous batches) on
-            # a schedulable node is device state; pods on excluded nodes
-            # still matter for nothing the kernel models per-node, so drop
-            existing = [p for name, ni in info.items() if name in node_set
-                        for p in ni.pods]
+            existing = None
+            if self._inc is None:
+                # full-rebuild path: snapshot the world per batch
+                info = self.f.cache.get_node_name_to_info_map()
+                node_set = {n.metadata.name for n in nodes}
+                # every cached pod (incl. assumed ones from previous batches)
+                # on a schedulable node is device state; pods on excluded
+                # nodes matter for nothing the kernel models per-node
+                existing = [p for name, ni in info.items() if name in node_set
+                            for p in ni.pods]
         except Exception as e:
             log.warning("cluster snapshot failed (%s); sequential fallback", e)
             self._fallback_sequential(pods)
@@ -244,6 +256,14 @@ class BatchScheduler(Scheduler):
                     f"{len(pods)} pods")
         except Exception as e:
             self._on_kernel_failure(e, len(pods))
+            if not _is_device_error(e):
+                # a corrupted incremental mirror would reproduce a BUG
+                # forever: rebuild it from the cache before the next attempt
+                # (transport errors can't corrupt host state — no resync)
+                try:
+                    self.resync_incremental()
+                except Exception:
+                    log.exception("incremental resync failed")
             # fallback first — the drained batch must never be dropped, even
             # when strict mode re-raises below
             self._fallback_sequential(pods)
@@ -264,9 +284,23 @@ class BatchScheduler(Scheduler):
 
     def _run_kernel(self, nodes: List[api.Node], existing: List[api.Pod],
                     pending: List[api.Pod]) -> List[Optional[str]]:
+        if self._inc is not None:
+            return self._inc.schedule(pending, self.weights)
         from kubernetes_tpu.scheduler.batch import tpu_batch
         return tpu_batch(nodes, existing, pending, self.f.plugin_args,
                          self.weights)
+
+    def resync_incremental(self):
+        """Drop and re-mirror the incremental state from the cache — the
+        self-heal for a corrupted mirror (called on kernel failure)."""
+        if self._inc is None:
+            return
+        from kubernetes_tpu.ops.incremental import IncrementalTensorizer
+        old = self._inc
+        fresh = IncrementalTensorizer(self.f.plugin_args)
+        self.f.cache.remove_listener(old)
+        self.f.cache.add_listener(fresh)
+        self._inc = fresh
 
     # --- loop ----------------------------------------------------------------
 
@@ -284,6 +318,8 @@ class BatchScheduler(Scheduler):
 
     def stop(self):
         super().stop()
+        if self._inc is not None:
+            self.f.cache.remove_listener(self._inc)
         self._bind_pool.shutdown(wait=False)
 
 
