@@ -243,6 +243,126 @@ def fail_json(stage, err, **detail):
     }))
 
 
+def _finite(q: float):
+    import math
+    return round(q, 4) if math.isfinite(q) else None
+
+
+def run_e2e(n_nodes: int, n_pods: int) -> dict:
+    """The LIVE path at full scale: pods created through the API server ->
+    informers -> FIFO -> BatchScheduler (incremental mirror) -> device
+    kernel -> assume + async bind -> CAS-accepted /bindings writes.
+
+    Reports wall-clock from scheduler start (first FIFO pop) to the last
+    CAS-accepted binding — the number BASELINE.md's <1s north star is
+    actually about, vs the reference harness shape
+    (test/component/scheduler/perf/scheduler_test.go:31, util.go:85-131)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    global N_NODES, N_PODS
+    saved = (N_NODES, N_PODS)
+    N_NODES, N_PODS = n_nodes, n_pods
+    try:
+        nodes, pending, services = build_cluster()
+    finally:
+        N_NODES, N_PODS = saved
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import RESTClient
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+    # teardown must run even when a phase raises: leaked informer/server
+    # threads would keep mutating the process-global metrics registry for
+    # the rest of the bench run
+    server = APIServer().start()
+    factory = sched = None
+    try:
+        client = RESTClient.for_server(server, qps=50000, burst=50000)
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=32) as pool:
+            list(pool.map(lambda n: client.create("nodes", n), nodes))
+            for svc in services:
+                client.create("services", svc)
+            list(pool.map(lambda p: client.create("pods", p), pending))
+        t_created = time.perf_counter()
+
+        factory = ConfigFactory(client)
+        factory.run()
+        # pre-queue: every pending pod in the FIFO before the scheduler runs
+        deadline = time.monotonic() + 120
+        while (len(factory.pending) < len(pending)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        queued = len(factory.pending)
+
+        sched = factory.create_batch_from_provider(batch_size=4096)
+        hist = METRICS.histogram("scheduler_e2e_scheduling_latency_seconds")
+        base = sum(hist._totals.values())
+        target = base + len(pending)
+
+        # warm the single program shape (pod_bucket pins every batch to one
+        # compile); a dry schedule() has no side effects beyond vocab/jit
+        t_warm = time.perf_counter()
+        warmup_err = None
+        try:
+            sched._inc.schedule(pending[: min(4096, len(pending))])
+        except Exception as e:
+            warmup_err = repr(e)
+        warmup_seconds = time.perf_counter() - t_warm
+
+        t_run = time.perf_counter()
+        t_last = t_run
+        sched.run()
+        deadline = time.monotonic() + float(
+            os.environ.get("BENCH_E2E_TIMEOUT", 600))
+        bound = base
+        while time.monotonic() < deadline:
+            now_bound = sum(hist._totals.values())
+            if now_bound > bound:
+                bound = now_bound
+                t_last = time.perf_counter()
+                if bound >= target:
+                    break
+            time.sleep(0.005)
+        wall = t_last - t_run
+        pods_bound = bound - base
+        inc = sched._inc
+        out = {
+            "nodes": len(nodes), "pods": len(pending), "queued": queued,
+            "pods_bound": pods_bound,
+            "wall_seconds_first_pop_to_last_bind": round(wall, 3),
+            "pods_per_sec": round(pods_bound / wall, 1) if wall > 0 else 0.0,
+            "create_seconds": round(t_created - t0, 1),
+            "warmup_compile_seconds": round(warmup_seconds, 1),
+            "kernel_batches": sched.kernel_batches,
+            "kernel_pods": sched.kernel_pods,
+            "kernel_failures": sched.kernel_failures,
+            "kernel_health": sched.health,
+            "bind_p99_seconds": _finite(METRICS.histogram(
+                "scheduler_binding_latency_seconds").quantile(0.99)),
+            # per-pod e2e latency counts queue wait across the whole drain,
+            # so late batches sit behind earlier ones; beyond-bucket -> null
+            "e2e_p99_seconds": _finite(hist.quantile(0.99)),
+        }
+        if warmup_err:
+            out["warmup_error"] = warmup_err
+        if inc is not None:
+            out["incremental"] = {
+                "builds": inc.builds,
+                "last_build_seconds": round(inc.last_build_seconds, 3),
+                "last_upload_bytes": inc.last_upload_bytes,
+                "pod_events": inc.pod_events,
+            }
+        return out
+    finally:
+        if sched is not None:
+            sched.stop()
+        if factory is not None:
+            factory.stop()
+        server.stop()
+
+
 def main():
     t_start = time.perf_counter()
     try:
@@ -343,6 +463,23 @@ def main():
     res = res_full[: ct.n_real_pods]
     scheduled = int((res >= 0).sum())
 
+    # the live end-to-end path (round-3 verdict #1b): full scale on the
+    # device; reduced scale on the CPU fallback so an honest number still
+    # lands instead of a multi-hour run
+    e2e = None
+    if os.environ.get("BENCH_E2E", "1") != "0":
+        if os.environ.get("BENCH_FORCE_CPU"):
+            e2e_nodes, e2e_pods = 1000, 8000
+        else:
+            e2e_nodes, e2e_pods = N_NODES, N_PODS
+        e2e_nodes = int(os.environ.get("BENCH_E2E_NODES", e2e_nodes))
+        e2e_pods = int(os.environ.get("BENCH_E2E_PODS", e2e_pods))
+        try:
+            e2e = run_with_timeout(
+                lambda: run_e2e(e2e_nodes, e2e_pods), 900, "e2e")
+        except Exception as e:
+            e2e = {"error": repr(e)}
+
     # correctness guard: no node overcommitted on cpu or pod slots
     assign = res[res >= 0]
     counts = np.bincount(assign, minlength=ct.n_real_nodes)
@@ -370,6 +507,8 @@ def main():
             "features": {k: bool(v) for k, v in feats._asdict().items()},
         },
     }
+    if e2e is not None:
+        result["detail"]["e2e"] = e2e
     if suspect:
         result["detail"]["estimator_notes"] = suspect
     if backend_err is not None:

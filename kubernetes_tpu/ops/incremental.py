@@ -125,9 +125,13 @@ class IncrementalTensorizer:
     def __init__(self, plugin_args=None,
                  failure_domains=(api.LABEL_HOSTNAME, api.LABEL_ZONE,
                                   api.LABEL_REGION),
-                 node_cap: int = LANE):
+                 node_cap: int = LANE, pod_bucket: Optional[int] = None):
         self.args = plugin_args
         self.failure_domains = tuple(failure_domains)
+        # fixed pod-axis pad (usually the scheduler's batch_size): every
+        # full batch AND the tail then trace to one program shape, so the
+        # whole drain costs a single XLA compile
+        self.pod_bucket = pod_bucket
         self._lock = threading.RLock()
         self._versions: Dict[str, int] = {}
 
@@ -196,6 +200,10 @@ class IncrementalTensorizer:
         self._placed: Dict[str, Tuple[api.Pod, int]] = {}
         self._by_sig: Dict[tuple, Dict[str, int]] = {}
         self._terminating: set = set()
+        self._dead_slots: set = set()   # node removed, pods still draining
+        # PVC-backed volume columns as resolved at ADD time, so removal
+        # reverses the same cells even if the PVC/PV changed meanwhile
+        self._pvc_cols: Dict[str, Tuple[list, list]] = {}
 
         # node-affinity expression machinery
         self._exprv = Vocab()          # (key, op, values) -> expr id
@@ -330,19 +338,8 @@ class IncrementalTensorizer:
     def _node_added(self, node: api.Node):
         with self._lock:
             self.node_events += 1
-            name = node.metadata.name
-            slot = self._node_index.get(name)
-            if slot is None:
-                if self._free:
-                    slot = self._free.pop()
-                else:
-                    if self._hi >= self.n_cap:
-                        self._grow_nodes()
-                    slot = self._hi
-                    self._hi += 1
-                self._node_index[name] = slot
-                self._node_names[slot] = name
-                self._slot_pods.setdefault(slot, 0)
+            slot = self._ensure_slot(node.metadata.name)
+            self._dead_slots.discard(slot)   # back from the dead (re-add)
             self._fill_node_statics(slot, node)
 
     def node_updated(self, node: api.Node):
@@ -387,27 +384,65 @@ class IncrementalTensorizer:
             self.taints_prefer[slot] = 0
             self.node_dom[:, slot] = -1
             self.zone_id[slot] = -1
+            self.expr_node[:, slot] = 0
+            self.pref_term_node[:, slot] = 0
             self._touch("node_valid", "node_labels", "taints_nosched",
-                        "taints_prefer", "node_dom", "zone_id")
+                        "taints_prefer", "node_dom", "zone_id", "expr_node",
+                        "pref_term_node")
             if not self._slot_pods.get(slot):
                 del self._node_index[node.metadata.name]
                 self._node_names[slot] = ""
                 self._free.append(slot)
+            else:
+                # pods still draining: reclaim when the last one leaves
+                self._dead_slots.add(slot)
             self._reinit_interpod()
 
     def _fill_node_statics(self, slot: int, node: api.Node):
+        """Write node-derived rows, touching only what actually changed —
+        routine status heartbeats must not defeat the device cache."""
+        touched = []
         a = api.node_allocatable(node)
-        self.alloc[slot] = (a[api.RESOURCE_CPU], a[api.RESOURCE_MEMORY] / MB,
-                            a[api.RESOURCE_GPU], a[api.RESOURCE_PODS])
+        alloc_row = np.array(
+            [a[api.RESOURCE_CPU], a[api.RESOURCE_MEMORY] / MB,
+             a[api.RESOURCE_GPU], a[api.RESOURCE_PODS]], np.float32)
+        if not np.array_equal(self.alloc[slot], alloc_row):
+            self.alloc[slot] = alloc_row
+            touched.append("alloc")
+
         lbls = _labels_of(node)
-        self._node_labels_d[slot] = lbls
-        for kv in lbls.items():
-            self._labelv.id(kv)
-        self._grow_cols("node_labels", self._labelv)
-        row = np.zeros(self.node_labels.shape[1], np.int8)
-        for kv in lbls.items():
-            row[self._labelv.get(kv)] = 1
-        self.node_labels[slot] = row
+        if self._node_labels_d.get(slot) != lbls:
+            self._node_labels_d[slot] = lbls
+            for kv in lbls.items():
+                self._labelv.id(kv)
+            self._grow_cols("node_labels", self._labelv)
+            row = np.zeros(self.node_labels.shape[1], np.int8)
+            for kv in lbls.items():
+                row[self._labelv.get(kv)] = 1
+            self.node_labels[slot] = row
+            touched.append("node_labels")
+
+            zk = _zone_key(node)
+            zid = self._zonev.id(zk) if zk else -1
+            if self.zone_id[slot] != zid:
+                self.zone_id[slot] = zid
+                touched.append("zone_id")
+
+            # topology domains for every registered key
+            for key, kid in list(self._keyv.items()):
+                val = lbls.get(key)
+                self.node_dom[kid, slot] = (self._dom_id(kid, val)
+                                            if val else -1)
+            touched.append("node_dom")
+
+            # node-affinity expression columns + pref term truth
+            for eid, req in enumerate(self._expr_reqs):
+                self.expr_node[eid, slot] = 1 if req.matches(lbls) else 0
+            for pid, (tid, _w) in enumerate(self._pref_entries):
+                eids = self._term_exprs[tid]
+                self.pref_term_node[pid, slot] = (
+                    1 if all(self.expr_node[e, slot] for e in eids) else 0)
+            touched += ["expr_node", "pref_term_node"]
 
         for t in ((node.spec.taints or []) if node.spec else []):
             self._taintv.id((t.key, t.value, t.effect))
@@ -421,47 +456,42 @@ class IncrementalTensorizer:
                 tns[tid] = 1
             elif t.effect == api.TAINT_PREFER_NO_SCHEDULE:
                 tpf[tid] = 1
-        self.taints_nosched[slot] = tns
-        self.taints_prefer[slot] = tpf
+        if not np.array_equal(self.taints_nosched[slot], tns):
+            self.taints_nosched[slot] = tns
+            touched.append("taints_nosched")
+        if not np.array_equal(self.taints_prefer[slot], tpf):
+            self.taints_prefer[slot] = tpf
+            touched.append("taints_prefer")
 
-        self.mem_pressure[slot] = any(
+        mp = any(
             c.type == api.NODE_MEMORY_PRESSURE and c.status == api.CONDITION_TRUE
             for c in ((node.status.conditions or []) if node.status else []))
-        self.node_valid[slot] = node_is_ready(node)
-
-        zk = _zone_key(node)
-        self.zone_id[slot] = self._zonev.id(zk) if zk else -1
-
-        # topology domains for every registered key
-        for key, kid in list(self._keyv.items()):
-            val = lbls.get(key)
-            self.node_dom[kid, slot] = self._dom_id(kid, val) if val else -1
+        if bool(self.mem_pressure[slot]) != mp:
+            self.mem_pressure[slot] = mp
+            touched.append("mem_pressure")
+        valid = node_is_ready(node)
+        if bool(self.node_valid[slot]) != valid:
+            self.node_valid[slot] = valid
+            touched.append("node_valid")
 
         # images present on the node (ImageLocality)
         imgs = {}
         for img in ((node.status.images or []) if node.status else []):
             for iname in (img.names or []):
                 imgs[iname] = img.size_bytes / MB
-        self._node_images_d[slot] = imgs
-        self._grow_cols("image_node_sizes", self._imagev)
-        irow = np.zeros(self.image_node_sizes.shape[1], np.float32)
-        for iname, mib in imgs.items():
-            iid = self._imagev.get(iname)
-            if iid is not None:
-                irow[iid] = mib
-        self.image_node_sizes[slot] = irow
+        if self._node_images_d.get(slot) != imgs:
+            self._node_images_d[slot] = imgs
+            self._grow_cols("image_node_sizes", self._imagev)
+            irow = np.zeros(self.image_node_sizes.shape[1], np.float32)
+            for iname, mib in imgs.items():
+                iid = self._imagev.get(iname)
+                if iid is not None:
+                    irow[iid] = mib
+            self.image_node_sizes[slot] = irow
+            touched.append("image_node_sizes")
 
-        # node-affinity expression columns + pref term truth for this node
-        for eid, req in enumerate(self._expr_reqs):
-            self.expr_node[eid, slot] = 1 if req.matches(lbls) else 0
-        for pid, (tid, _w) in enumerate(self._pref_entries):
-            eids = self._term_exprs[tid]
-            self.pref_term_node[pid, slot] = (
-                1 if all(self.expr_node[e, slot] for e in eids) else 0)
-
-        self._touch("alloc", "node_labels", "taints_nosched", "taints_prefer",
-                    "mem_pressure", "node_valid", "zone_id", "node_dom",
-                    "image_node_sizes", "expr_node", "pref_term_node")
+        if touched:
+            self._touch(*touched)
 
     # --- pod events (listener interface) --------------------------------------
 
@@ -522,7 +552,7 @@ class IncrementalTensorizer:
                 self.node_ports0[slot, c] = 1 if self._ports_cnt[slot, c] > 0 else 0
             self._touch("node_ports0")
 
-        self._apply_volumes(pod, slot, sign, shape)
+        self._apply_volumes(pod, slot, sign, shape, key)
         self._apply_groups(pod, slot, sign)
         self._apply_interpod(pod, slot, sign)
 
@@ -542,6 +572,13 @@ class IncrementalTensorizer:
                 if not grp:
                     del self._by_sig[sig]
             self._slot_pods[slot] = max(self._slot_pods.get(slot, 0) - 1, 0)
+            if not self._slot_pods[slot] and slot in self._dead_slots:
+                # last pod drained off a removed node: reclaim the slot so
+                # node churn doesn't grow the slot space without bound
+                self._dead_slots.discard(slot)
+                self._node_index.pop(node_name, None)
+                self._node_names[slot] = ""
+                self._free.append(slot)
 
     # --- volumes (NoDiskConflict / MaxPDVolumeCount occupancy) ---------------
 
@@ -571,7 +608,8 @@ class IncrementalTensorizer:
                                    MaxPDVolumeCountChecker("gce-pd", 0, pvc, pv))
         return ck
 
-    def _apply_volumes(self, pod: api.Pod, slot: int, sign: int, shape: dict):
+    def _apply_volumes(self, pod: api.Pod, slot: int, sign: int, shape: dict,
+                       key: str):
         if not (shape["disk_pairs"] or shape["direct_ebs"]
                 or shape["direct_gce"] or shape["has_pvc"]):
             return
@@ -584,8 +622,13 @@ class IncrementalTensorizer:
         ecols = list(shape["direct_ebs"])
         gcols = list(shape["direct_gce"])
         if shape["has_pvc"]:
-            ns = pod.metadata.namespace if pod.metadata else ""
-            _z, _b, pe, pg = self._pvc_info(ns, shape["claims"], {})
+            if sign < 0 and key in self._pvc_cols:
+                pe, pg = self._pvc_cols.pop(key)
+            else:
+                ns = pod.metadata.namespace if pod.metadata else ""
+                _z, _b, pe, pg = self._pvc_info(ns, shape["claims"], {})
+                if sign > 0:
+                    self._pvc_cols[key] = (pe, pg)
             ecols += pe
             gcols += pg
         for c in ecols:
@@ -711,16 +754,18 @@ class IncrementalTensorizer:
         lbls = _labels_of(pod)
 
         # 1) this placed pod matches pending-owned term rows -> hit counts
+        # (the match set is the same one build() needs, so reuse its memo
+        # instead of a per-event O(terms) selector rescan)
+        lsig = tuple(sorted(lbls.items()))
         touched = []
-        for name, table in (("req_hit0", self.req_t),
-                            ("anti_hit0", self.anti_t),
-                            ("pref_hit0", self.pref_t)):
-            for tid in range(len(table.rows)):
-                if table.matches(tid, ns, lbls):
-                    kids = [k for k in table.rows[tid][2] if k is not None]
-                    table.hits[tid] += sign * self._domain_mask(slot, kids)
-                    table.totals[tid] += sign
-                    touched.append(name)
+        for name, memo_name, table in (("req_hit0", "req", self.req_t),
+                                       ("anti_hit0", "anti", self.anti_t),
+                                       ("pref_hit0", "pref", self.pref_t)):
+            for tid in self._match_ids(memo_name, table, ns, lsig):
+                kids = [k for k in table.rows[tid][2] if k is not None]
+                table.hits[tid] += sign * self._domain_mask(slot, kids)
+                table.totals[tid] += sign
+                touched.append(name)
 
         # 2) this placed pod's own terms -> sym (hard anti) and te (reverse
         # preferred + reverse-hard) tables
@@ -1104,6 +1149,8 @@ class IncrementalTensorizer:
     def _build_locked(self, pending: List[api.Pod]) -> ClusterTensors:
         P = len(pending)
         Pp = _bucket(P)
+        if self.pod_bucket and P <= self.pod_bucket:
+            Pp = self.pod_bucket
         shapes = [self._shape_of(pod) for pod in pending]
 
         # pass 1: group registration per distinct (ns, labels) signature

@@ -117,7 +117,8 @@ class BatchScheduler(Scheduler):
         self._inc = None
         if incremental:
             from kubernetes_tpu.ops.incremental import IncrementalTensorizer
-            self._inc = IncrementalTensorizer(factory.plugin_args)
+            self._inc = IncrementalTensorizer(factory.plugin_args,
+                                              pod_bucket=batch_size)
             factory.cache.add_listener(self._inc)
         self.kernel_batches = 0     # successful device batches
         self.kernel_pods = 0        # pods placed via the device path
@@ -297,7 +298,8 @@ class BatchScheduler(Scheduler):
             return
         from kubernetes_tpu.ops.incremental import IncrementalTensorizer
         old = self._inc
-        fresh = IncrementalTensorizer(self.f.plugin_args)
+        fresh = IncrementalTensorizer(self.f.plugin_args,
+                                      pod_bucket=self.batch_size)
         self.f.cache.remove_listener(old)
         self.f.cache.add_listener(fresh)
         self._inc = fresh

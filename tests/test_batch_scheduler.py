@@ -104,20 +104,24 @@ def build_cluster(client, n_nodes=6, n_pods=40):
 
 
 class TestBatchSchedulerE2E:
-    def test_kernel_path_binds_pods(self, client):
+    def test_kernel_path_binds_pods(self, client, caplog):
+        import logging
         nodes, pods, services = build_cluster(client)
         factory = ConfigFactory(client)
         factory.run()
-        sched = factory.create_batch_from_provider(batch_size=128).run()
-        try:
-            done = wait_scheduled(client, len(pods))
-        finally:
-            sched.stop()
-            factory.stop()
+        with caplog.at_level(logging.WARNING, logger="scheduler.tpu"):
+            sched = factory.create_batch_from_provider(batch_size=128).run()
+            try:
+                done = wait_scheduled(client, len(pods))
+            finally:
+                sched.stop()
+                factory.stop()
         # the device path, not the fallback, did the placing
-        assert sched.kernel_failures == 0
+        assert sched.kernel_failures == 0, (
+            f"health={sched.health} reason={sched.disabled_reason}\n"
+            f"{caplog.text}")
         assert sched.kernel_batches >= 1
-        assert sched.kernel_pods == len(pods)
+        assert sched.kernel_pods == len(pods), caplog.text
         # constraints honored end-to-end
         by_name = {n.metadata.name: n for n in nodes}
         for p in done:

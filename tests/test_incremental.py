@@ -228,6 +228,34 @@ class TestEventHistoryEquivalence:
         assert got == ["b"]
 
 
+class TestChurnHygiene:
+    def test_slot_reclaimed_after_drain(self):
+        """node removed with pods still on it: the slot frees once the last
+        pod drains, so churn can't grow the node axis without bound."""
+        nodes = [mk_node("a"), mk_node("b")]
+        args = mk_args(nodes)
+        cache, inc = mirrored(nodes, [], args)
+        p = mk_pod("x", node="a", cpu="100m")
+        cache.add_pod(p)
+        cache.remove_node(nodes[0])
+        assert "a" in inc._node_index          # still draining
+        cache.remove_pod(p)
+        assert "a" not in inc._node_index      # reclaimed
+        free_before = len(inc._free)
+        cache.add_node(mk_node("c"))
+        assert len(inc._free) == free_before - 1   # slot reused
+
+    def test_heartbeat_does_not_dirty_device_cache(self):
+        """A status-only node update (same labels/taints/alloc) must not
+        bump node-side versions — heartbeats are the common case."""
+        nodes = [mk_node(f"n{i}") for i in range(4)]
+        args = mk_args(nodes)
+        cache, inc = mirrored(nodes, [], args)
+        before = dict(inc._versions)
+        cache.update_node(deep_copy(nodes[0]))   # identical heartbeat
+        assert inc._versions == before
+
+
 class TestDeviceResidency:
     def test_dirty_upload_shrinks(self):
         """Steady state re-uploads only what changed, not the world."""
