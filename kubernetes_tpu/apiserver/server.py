@@ -44,6 +44,7 @@ from kubernetes_tpu.registry.generic import (
     RESOURCES, Registry, RegistryError, bad_request,
 )
 from kubernetes_tpu.storage import TooOldResourceVersion
+from kubernetes_tpu.storage import store as store_mod
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 _PATH = re.compile(
@@ -62,12 +63,20 @@ class APIServer:
 
     def __init__(self, registry: Optional[Registry] = None, host: str = "127.0.0.1",
                  port: int = 0, admission_control: Optional[list] = None,
-                 authenticator=None, authorizer=None):
+                 authenticator=None, authorizer=None,
+                 max_in_flight: int = 400):
         self.registry = registry or Registry()
         self._host = host
         self._port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # server-side flow control (reference MaxInFlightLimit,
+        # pkg/apiserver/handlers.go): non-long-running requests beyond the
+        # cap get 429 instead of queueing unboundedly. Watches are exempt
+        # (long-running, like the reference's longRunningRequestCheck).
+        self.max_in_flight = max_in_flight
+        self._inflight = threading.BoundedSemaphore(max_in_flight) \
+            if max_in_flight else None
         # admission chain (reference --admission-control flag; the chain runs
         # between decode and storage, cmd/kube-apiserver/app/server.go)
         self.admission = None
@@ -172,27 +181,47 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _route(self, method: str):
         # watch streams live for hours; timing them as requests would poison
-        # the latency histogram (they have their own counter)
+        # the latency histogram (they have their own counter), and they are
+        # exempt from the in-flight cap (longRunningRequestCheck)
         q = parse_qs(urlparse(self.path).query)
         is_watch = q.get("watch", ["false"])[0] in ("true", "1")
+        sem = None if is_watch else self.server_ref._inflight
+        if sem is not None and not sem.acquire(blocking=False):
+            METRICS.inc("apiserver_dropped_requests", verb=method)
+            try:
+                # drain the unread body first or the keep-alive stream
+                # desyncs (the next request would parse the leftover bytes)
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                self._send_status(429, "TooManyRequests",
+                                  "too many requests in flight; retry")
+            except OSError:
+                pass
+            return
         timer = (contextlib.nullcontext() if is_watch
                  else METRICS.time("apiserver_request_seconds", verb=method))
-        with timer:
-            try:
-                self._route_inner(method)
-            except RegistryError as e:
-                self._send_status(e.code, e.reason, e.message)
-            except TooOldResourceVersion as e:
-                self._send_status(410, "Expired", str(e))
-            except BrokenPipeError:
-                pass
-            except Exception as e:  # HandleCrash equivalent
-                import traceback
-                traceback.print_exc()
+        try:
+            with timer:
                 try:
-                    self._send_status(500, "InternalError", f"{type(e).__name__}: {e}")
-                except Exception:
+                    self._route_inner(method)
+                except RegistryError as e:
+                    self._send_status(e.code, e.reason, e.message)
+                except TooOldResourceVersion as e:
+                    self._send_status(410, "Expired", str(e))
+                except BrokenPipeError:
                     pass
+                except Exception as e:  # HandleCrash equivalent
+                    import traceback
+                    traceback.print_exc()
+                    try:
+                        self._send_status(500, "InternalError",
+                                          f"{type(e).__name__}: {e}")
+                    except Exception:
+                        pass
+        finally:
+            if sem is not None:
+                sem.release()
 
     def _route_inner(self, method: str):
         url = urlparse(self.path)
@@ -498,12 +527,26 @@ class _Handler(BaseHTTPRequestHandler):
             while True:
                 ev = watcher.next(timeout=30.0)
                 if ev is None:
+                    if watcher.stopped:
+                        break  # dropped/cancelled: end the stream
                     # heartbeat: blank line (JSON) / zero-length frame
                     # (binary) so a dead TCP peer raises BrokenPipe and we
                     # reclaim thread + watcher
                     self._write_chunk(b"\x00\x00\x00\x00" if binary
                                       else b"\n")
                     continue
+                if ev.type == store_mod.ERROR:
+                    # slow-watcher drop (cacher.go:73): terminal ERROR frame,
+                    # then close; the Reflector answers with a re-list
+                    METRICS.inc("apiserver_watch_drops", resource=resource)
+                    payload = {"type": "ERROR", "object": ev.obj}
+                    if binary:
+                        body = binary_codec.encode_dict(payload)
+                        self._write_chunk(len(body).to_bytes(4, "big") + body)
+                    else:
+                        self._write_chunk(json.dumps(
+                            payload, separators=(",", ":")).encode() + b"\n")
+                    break
                 out = self._transform_for_selectors(rd, ev, lsel, fsel)
                 if out is None:
                     continue

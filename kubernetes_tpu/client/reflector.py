@@ -115,6 +115,14 @@ class Reflector:
                 for etype, obj in stream:
                     if self._stop.is_set():
                         return
+                    if etype == "ERROR":
+                        # server dropped us (slow watcher / expired window):
+                        # obj is a Status dict — answer with a full re-list,
+                        # AFTER a backoff (we were dropped because we're too
+                        # slow; an immediate O(N) list would amplify that)
+                        log.warning("%s: error event: %s", self.name, obj)
+                        self._stop.wait(self.relist_backoff)
+                        return
                     rv = int(obj.metadata.resource_version or rv)
                     self.last_sync_rv = rv
                     if etype == "ADDED":
@@ -123,9 +131,6 @@ class Reflector:
                         self.sink.update(obj)
                     elif etype == "DELETED":
                         self.sink.delete(obj)
-                    elif etype == "ERROR":
-                        log.warning("%s: error event: %s", self.name, obj)
-                        return
             finally:
                 self._active_watch = None
                 stream.stop()

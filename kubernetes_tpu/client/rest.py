@@ -89,6 +89,11 @@ class WatchStream:
             for frame in self._frames():
                 if self._stopped:
                     return
+                if frame.get("type") == "ERROR":
+                    # terminal server-side error (slow-watcher drop / 410):
+                    # the object is a Status dict, not a resource
+                    yield "ERROR", frame.get("object")
+                    return
                 obj = from_dict(self._cls, frame["object"])
                 yield frame["type"], obj
         except (http.client.HTTPException, OSError, ValueError, AttributeError):
@@ -163,6 +168,23 @@ class RESTClient:
             self._local.conn = None
 
     def request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        # 429 = server-side max-in-flight shed the request before executing
+        # it: always safe to retry after a short backoff (the reference
+        # client honors Retry-After the same way)
+        for backoff in (0.1, 0.4, 1.0, 2.0, None):
+            parsed = self._request_once(method, path, body)
+            if parsed.get("code") == 429 and backoff is not None:
+                import time as _time
+                _time.sleep(backoff)
+                continue
+            if parsed.get("code") == 429:
+                raise ApiError(429, parsed.get("reason", "TooManyRequests"),
+                               parsed.get("message", ""))
+            return parsed
+        raise AssertionError("unreachable")
+
+    def _request_once(self, method: str, path: str,
+                      body: Optional[dict] = None) -> dict:
         self._limiter.accept()
         binary = self.content_type == binary_codec.CONTENT_TYPE
         if body is None:
@@ -205,6 +227,11 @@ class RESTClient:
             parsed = binary_codec.decode_dict(data)
         else:
             parsed = json.loads(data)
+        if resp.status == 429:
+            # flow-control shed: surfaced as a dict so request() can retry
+            return {"kind": "Status", "code": 429,
+                    "reason": parsed.get("reason", "TooManyRequests"),
+                    "message": parsed.get("message", "")}
         if resp.status >= 400:
             raise ApiError(resp.status, parsed.get("reason", "Unknown"),
                            parsed.get("message", ""))

@@ -201,6 +201,7 @@ class IncrementalTensorizer:
         self._by_sig: Dict[tuple, Dict[str, int]] = {}
         self._terminating: set = set()
         self._dead_slots: set = set()   # node removed, pods still draining
+        self._live_nodes: set = set()   # names with a live node object
         # PVC-backed volume columns as resolved at ADD time, so removal
         # reverses the same cells even if the PVC/PV changed meanwhile
         self._pvc_cols: Dict[str, Tuple[list, list]] = {}
@@ -338,6 +339,7 @@ class IncrementalTensorizer:
     def _node_added(self, node: api.Node):
         with self._lock:
             self.node_events += 1
+            self._live_nodes.add(node.metadata.name)
             slot = self._ensure_slot(node.metadata.name)
             self._dead_slots.discard(slot)   # back from the dead (re-add)
             self._fill_node_statics(slot, node)
@@ -373,6 +375,7 @@ class IncrementalTensorizer:
     def _node_removed(self, node: api.Node):
         with self._lock:
             self.node_events += 1
+            self._live_nodes.discard(node.metadata.name)
             slot = self._node_index.get(node.metadata.name)
             if slot is None:
                 return
@@ -510,6 +513,11 @@ class IncrementalTensorizer:
             self._node_index[node_name] = slot
             self._node_names[slot] = node_name
             self._slot_pods.setdefault(slot, 0)
+        if node_name not in self._live_nodes:
+            # no live node object behind this slot (pod-before-node, or a
+            # MODIFIED while draining off a removed node): keep it marked
+            # dead so it frees when the last pod leaves
+            self._dead_slots.add(slot)
         return slot
 
     def pod_added(self, pod: api.Pod):

@@ -15,9 +15,11 @@ Semantics mirrored from the reference's storage contract:
 - Values are plain JSON-ready dicts (the storage layer is codec-agnostic,
   like etcd storing bytes); typed encode/decode happens in the registry.
 
-Thread-safe; watchers receive events on unbounded queues so a slow watcher
-cannot block writers (the reference drops slow watchers instead — we keep
-them and let the queue grow, acceptable in-process).
+Thread-safe. Watcher queues are BOUNDED (watcher_queue): a watcher that
+falls `watcher_queue` events behind is dropped with a terminal ERROR event
+instead of blocking writers or growing without bound — the reference
+cacher's slow-watcher termination (pkg/storage/cacher.go:73, chanSize
+forwarder). Clients answer the ERROR by re-listing (Reflector contract).
 """
 
 from __future__ import annotations
@@ -76,21 +78,46 @@ def _copy(obj: dict) -> dict:
 
 
 class _Watcher:
-    """One watch stream. Iterate to consume events; `stop()` to cancel."""
+    """One watch stream. Iterate to consume events; `stop()` to cancel.
 
-    def __init__(self, store: "MemStore", prefix: str, pending: List[Event]):
+    maxlen bounds the live queue: overflow drops the watcher with an ERROR
+    event (slow-watcher termination, cacher.go:73). The initial replay is
+    exempt (it is already bounded by the store's retained window)."""
+
+    def __init__(self, store: "MemStore", prefix: str, pending: List[Event],
+                 maxlen: int = 0):
         import queue
 
         self._store = store
         self.prefix = prefix
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._maxlen = maxlen
         self._stopped = False
+        self.dropped = False
         for ev in pending:
             self._q.put(ev)
 
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
     def _deliver(self, ev: Event):
-        if not self._stopped and ev.key.startswith(self.prefix):
-            self._q.put(ev)
+        if self._stopped or not ev.key.startswith(self.prefix):
+            return
+        if self._maxlen and self._q.qsize() >= self._maxlen:
+            # too far behind: cut it loose rather than block writers or
+            # grow the queue without bound; the client re-lists
+            self._stopped = True
+            self.dropped = True
+            self._store._remove_watcher(self)
+            self._q.put(Event(ERROR, self.prefix, ev.rv, {
+                "kind": "Status", "status": "Failure", "reason": "Expired",
+                "message": f"watch fell {self._maxlen} events behind and "
+                           f"was dropped; re-list and re-watch", "code": 410,
+            }))
+            self._q.put(None)
+            return
+        self._q.put(ev)
 
     def stop(self):
         if not self._stopped:
@@ -122,11 +149,12 @@ class MemStore:
     """The versioned KV + watch window. Keys are '/'-separated paths like
     '/pods/default/web-1' (reference key layout '/registry/pods/<ns>/<name>')."""
 
-    def __init__(self, window: int = 4096):
+    def __init__(self, window: int = 4096, watcher_queue: int = 4096):
         self._lock = threading.RLock()
         self._data: Dict[str, Tuple[dict, int]] = {}
         self._rv = 0
         self._events: deque = deque(maxlen=window)
+        self._watcher_queue = watcher_queue
         self._watchers: List[_Watcher] = []
 
     # --- reads ---------------------------------------------------------------
@@ -231,7 +259,7 @@ class MemStore:
                     raise TooOldResourceVersion(since_rv, oldest_buffered)
                 pending = [e for e in self._events
                            if e.rv > since_rv and e.key.startswith(prefix)]
-            w = _Watcher(self, prefix, pending)
+            w = _Watcher(self, prefix, pending, maxlen=self._watcher_queue)
             self._watchers.append(w)
             return w
 

@@ -239,7 +239,13 @@ class TestChurnHygiene:
         cache.add_pod(p)
         cache.remove_node(nodes[0])
         assert "a" in inc._node_index          # still draining
-        cache.remove_pod(p)
+        # a MODIFIED while draining (the normal pre-DELETE sequence) must
+        # not launder the dead mark off the slot
+        p2 = deep_copy(p)
+        p2.metadata.deletion_timestamp = "2026-01-01T00:00:00Z"
+        cache.update_pod(p2)
+        assert "a" in inc._node_index
+        cache.remove_pod(p2)
         assert "a" not in inc._node_index      # reclaimed
         free_before = len(inc._free)
         cache.add_node(mk_node("c"))
@@ -370,11 +376,13 @@ class TestSchedulerWiring:
                 client.create("pods", bpod(f"p-{i}"))
             sched.run()
             try:
-                wait_scheduled(client, 6, timeout=30)
+                wait_scheduled(client, 6, timeout=90)
             finally:
                 sched.stop()
                 factory.stop()
-            assert sched.kernel_pods == 6 and sched.kernel_failures == 0
+            assert sched.kernel_pods == 6 and sched.kernel_failures == 0, (
+                f"health={sched.health} reason={sched.disabled_reason} "
+                f"pods={sched.kernel_pods} failures={sched.kernel_failures}")
             assert sched._inc.builds >= 1
         finally:
             server.stop()

@@ -68,3 +68,72 @@ def test_hollow_cluster_schedules_wave():
             if c is not None:
                 c.stop()
         server.stop()
+
+
+@pytest.mark.slow
+def test_hollow_cluster_saturation_250_nodes():
+    """250 hollow nodes with a 10-pod cap, driven to FULL saturation by the
+    batch scheduler: every node ends exactly at its cap and the next pod is
+    unschedulable — the kubemark shape actually exercising the pods-per-node
+    limit (cluster/kubemark/config-default.sh:26 analogue at CI scale)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    server = APIServer().start()
+    client = RESTClient.for_server(server, qps=50000, burst=50000)
+    hollow = sched = factory = None
+    n_nodes, cap = 250, 10
+    n_pods = n_nodes * cap
+    try:
+        hollow = HollowCluster(client, num_nodes=n_nodes, pods=str(cap)).start()
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            list(pool.map(lambda i: client.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name=f"sat-{i:04d}",
+                                        namespace="default"),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="pause",
+                    resources=api.ResourceRequirements(
+                        requests={"cpu": "10m", "memory": "16Mi"}))]))),
+                range(n_pods)))
+
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(batch_size=1024).run()
+
+        deadline = time.monotonic() + 240
+        bound = []
+        while time.monotonic() < deadline:
+            pods, _ = client.list("pods", "default")
+            bound = [p for p in pods if p.spec.node_name]
+            if len(bound) == n_pods:
+                break
+            time.sleep(0.3)
+        assert len(bound) == n_pods, f"{len(bound)}/{n_pods} bound"
+
+        by_node = {}
+        for p in bound:
+            by_node[p.spec.node_name] = by_node.get(p.spec.node_name, 0) + 1
+        assert len(by_node) == n_nodes          # every node used
+        assert set(by_node.values()) == {cap}   # all exactly at cap
+
+        # saturated cluster: one more pod must be unschedulable
+        client.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="overflow", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(name="c",
+                                                       image="pause")])))
+        deadline = time.monotonic() + 20
+        cond = None
+        while time.monotonic() < deadline:
+            p = client.get("pods", "overflow", "default")
+            if p.spec.node_name:
+                raise AssertionError("overflow pod bound past the cap")
+            conds = (p.status.conditions or []) if p.status else []
+            cond = next((c for c in conds if c.type == api.POD_SCHEDULED), None)
+            if cond is not None and cond.status == api.CONDITION_FALSE:
+                break
+            time.sleep(0.2)
+        assert cond is not None and cond.reason == "Unschedulable"
+    finally:
+        for c in (sched, factory, hollow):
+            if c is not None:
+                c.stop()
+        server.stop()
