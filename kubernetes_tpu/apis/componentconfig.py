@@ -35,6 +35,22 @@ class KubeSchedulerConfiguration:
 
 
 @dataclass
+class APIServerConfiguration:
+    bind_address: str = "127.0.0.1"
+    port: int = 8080
+    data_dir: str = ""          # empty = memory-only store
+    max_in_flight: int = 400
+    watcher_queue: int = 4096
+    admission_control: str = ""  # comma-separated plugin names
+
+
+@dataclass
+class ControllerManagerConfiguration:
+    port: int = 10252
+    leader_elect: bool = False
+
+
+@dataclass
 class LeaderElectionConfiguration:
     leader_elect: bool = False
     lease_duration_seconds: float = 15.0
@@ -67,5 +83,7 @@ for _kind, _cls in {
     "LeaderElectionConfiguration": LeaderElectionConfiguration,
     "KubeProxyConfiguration": KubeProxyConfiguration,
     "KubeletConfiguration": KubeletConfiguration,
+    "APIServerConfiguration": APIServerConfiguration,
+    "ControllerManagerConfiguration": ControllerManagerConfiguration,
 }.items():
     scheme.add_known_type(GROUP_VERSION, _kind, _cls)

@@ -1,0 +1,70 @@
+"""kube-apiserver entrypoint: python -m kubernetes_tpu.apiserver
+
+Flags bind to the versioned APIServerConfiguration (componentconfig), which
+is served live at /configz (reference cmd/kube-apiserver/app/server.go:79-281
+pattern: flags -> versioned config -> component)."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from kubernetes_tpu.apis.componentconfig import APIServerConfiguration
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.registry.generic import Registry
+
+
+def build_config(argv=None) -> APIServerConfiguration:
+    p = argparse.ArgumentParser(prog="kube-apiserver")
+    p.add_argument("--bind-address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--data-dir", default="",
+                   help="WAL+snapshot directory; empty = memory-only")
+    p.add_argument("--max-requests-inflight", type=int, default=400,
+                   dest="max_in_flight")
+    p.add_argument("--watcher-queue", type=int, default=4096)
+    p.add_argument("--admission-control", default="")
+    a = p.parse_args(argv)
+    return APIServerConfiguration(
+        bind_address=a.bind_address, port=a.port, data_dir=a.data_dir,
+        max_in_flight=a.max_in_flight, watcher_queue=a.watcher_queue,
+        admission_control=a.admission_control)
+
+
+def build_server(cfg: APIServerConfiguration) -> APIServer:
+    if cfg.data_dir:
+        from kubernetes_tpu.storage.durable import DurableStore
+        store = DurableStore(cfg.data_dir, watcher_queue=cfg.watcher_queue)
+    else:
+        from kubernetes_tpu.storage.store import MemStore
+        store = MemStore(watcher_queue=cfg.watcher_queue)
+    admission = ([s for s in cfg.admission_control.split(",") if s]
+                 or None)
+    server = APIServer(Registry(store), host=cfg.bind_address, port=cfg.port,
+                       admission_control=admission,
+                       max_in_flight=cfg.max_in_flight)
+    server.configz["apiserver"] = cfg
+    return server
+
+
+def main(argv=None) -> int:
+    cfg = build_config(argv)
+    server = build_server(cfg).start()
+    # parseable by wrappers (localup) even with --port 0
+    print(f"apiserver listening on http://{cfg.bind_address}:{server.port}",
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    store = server.registry.store
+    server.stop()
+    if hasattr(store, "close"):
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

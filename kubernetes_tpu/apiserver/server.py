@@ -77,6 +77,9 @@ class APIServer:
         self.max_in_flight = max_in_flight
         self._inflight = threading.BoundedSemaphore(max_in_flight) \
             if max_in_flight else None
+        # /configz registry (pkg/util/configz): entrypoints mount their
+        # componentconfig objects here
+        self.configz: dict = {}
         # admission chain (reference --admission-control flag; the chain runs
         # between decode and storage, cmd/kube-apiserver/app/server.go)
         self.admission = None
@@ -239,6 +242,12 @@ class _Handler(BaseHTTPRequestHandler):
                                          "gitVersion": "kubernetes-tpu-0.1"})
         if url.path == "/metrics":
             return self._send_plain(200, METRICS.render().encode())
+        if url.path == "/configz":
+            # live component configuration (pkg/util/configz)
+            from dataclasses import asdict, is_dataclass
+            payload = {name: (asdict(o) if is_dataclass(o) else o)
+                       for name, o in self.server_ref.configz.items()}
+            return self._send_json(200, payload)
 
         if url.path == "/api":
             return self._send_json(200, {"kind": "APIVersions",

@@ -1,0 +1,47 @@
+"""kube-controller-manager entrypoint:
+python -m kubernetes_tpu.controllers
+
+Flags bind to ControllerManagerConfiguration, served at /configz next to
+/healthz and /metrics (reference cmd/kube-controller-manager/app/
+controllermanager.go:198-477 + leader election at :157)."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from kubernetes_tpu.apis.componentconfig import ControllerManagerConfiguration
+from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.utils.debugserver import DebugServer, client_from_url
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-controller-manager")
+    p.add_argument("--master", default="http://127.0.0.1:8080")
+    p.add_argument("--port", type=int, default=10252)
+    p.add_argument("--leader-elect", action="store_true")
+    a = p.parse_args(argv)
+    cfg = ControllerManagerConfiguration(port=a.port,
+                                         leader_elect=a.leader_elect)
+
+    client = client_from_url(a.master, qps=1000, burst=1000)
+    mgr = ControllerManager(client, leader_elect=cfg.leader_elect)
+    mgr.start()
+    debug = DebugServer(port=cfg.port,
+                        configz={"componentconfig": cfg}).start()
+    print(f"controller-manager debug on http://127.0.0.1:{debug.port}",
+          flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a_: stop.set())
+    signal.signal(signal.SIGINT, lambda *a_: stop.set())
+    stop.wait()
+    mgr.stop()
+    debug.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,10 +32,10 @@ class CommandError(Exception):
 
 
 def _client(args) -> RESTClient:
-    host, _, port = (args.server or "127.0.0.1:8080").partition(":")
-    return RESTClient(host=host, port=int(port or 8080),
-                      user_agent="kubectl",
-                      bearer_token=getattr(args, "token", None) or "")
+    from kubernetes_tpu.utils.debugserver import client_from_url
+    return client_from_url(args.server or "127.0.0.1:8080",
+                           user_agent="kubectl",
+                           bearer_token=getattr(args, "token", None) or "")
 
 
 def _ns(args) -> str:

@@ -1,0 +1,58 @@
+"""kubelet entrypoint: python -m kubernetes_tpu.kubelet
+
+Flags bind to KubeletConfiguration, served at /configz next to /healthz and
+/metrics (the reference kubelet's :10250 server, pkg/kubelet/server/
+server.go:237-270). The runtime is the in-process FakeRuntime (hollow-node
+semantics, cmd/kubemark/hollow-node.go:103-138) — there is no container
+engine in this environment, so every node is a hollow node."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import socket
+import sys
+import threading
+
+from kubernetes_tpu.apis.componentconfig import KubeletConfiguration
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.runtime import FakeCadvisor, FakeRuntime
+from kubernetes_tpu.utils.debugserver import DebugServer, client_from_url
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kubelet")
+    p.add_argument("--master", default="http://127.0.0.1:8080")
+    p.add_argument("--node-name", default=socket.gethostname())
+    p.add_argument("--port", type=int, default=10250)
+    p.add_argument("--max-pods", type=int, default=110)
+    p.add_argument("--cpu", default="4")
+    p.add_argument("--memory", default="32Gi")
+    p.add_argument("--node-status-update-frequency", type=float, default=10.0)
+    a = p.parse_args(argv)
+    cfg = KubeletConfiguration(
+        port=a.port, max_pods=a.max_pods,
+        node_status_update_frequency_seconds=a.node_status_update_frequency)
+
+    client = client_from_url(a.master, qps=100, burst=200)
+    kl = Kubelet(client, a.node_name, runtime=FakeRuntime(),
+                 cadvisor=FakeCadvisor(cpu=a.cpu, memory=a.memory,
+                                       pods=str(a.max_pods)),
+                 heartbeat_period=a.node_status_update_frequency)
+    kl.start()
+    debug = DebugServer(port=cfg.port,
+                        configz={"componentconfig": cfg}).start()
+    print(f"kubelet {a.node_name} debug on http://127.0.0.1:{debug.port}",
+          flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a_: stop.set())
+    signal.signal(signal.SIGINT, lambda *a_: stop.set())
+    stop.wait()
+    kl.stop()
+    debug.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+"""Local multi-process cluster: python -m kubernetes_tpu.localup
+
+The hack/local-up-cluster.sh analogue: boots the apiserver, scheduler,
+controller-manager, N hollow kubelets, and a proxy — each as its OWN
+process via its `python -m` entrypoint — then waits. kubectl talks to the
+printed master URL. Ctrl-C tears everything down."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+class LocalCluster:
+    """Spawns the component processes; test-friendly start()/stop()."""
+
+    def __init__(self, nodes: int = 2, port: int = 0, data_dir: str = "",
+                 tpu_backend: bool = True):
+        self.nodes = nodes
+        self.port = port
+        self.data_dir = data_dir
+        self.tpu_backend = tpu_backend
+        self.master_url: Optional[str] = None
+        self.procs: List[subprocess.Popen] = []
+
+    def _spawn(self, *args) -> subprocess.Popen:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", *args],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        self.procs.append(proc)
+        return proc
+
+    def start(self, timeout: float = 60.0) -> "LocalCluster":
+        apiserver = self._spawn(
+            "kubernetes_tpu.apiserver", "--port", str(self.port),
+            *(["--data-dir", self.data_dir] if self.data_dir else []))
+        # the apiserver prints its bound address (works with --port 0)
+        line = apiserver.stdout.readline()
+        if "listening on " not in line:
+            raise RuntimeError(f"apiserver failed to start: {line!r}")
+        self.master_url = line.strip().split("listening on ")[1]
+
+        self._spawn("kubernetes_tpu.scheduler", "--master", self.master_url,
+                    "--port", "0",
+                    "--tpu-backend", "true" if self.tpu_backend else "false")
+        self._spawn("kubernetes_tpu.controllers", "--master", self.master_url,
+                    "--port", "0")
+        for i in range(self.nodes):
+            self._spawn("kubernetes_tpu.kubelet", "--master", self.master_url,
+                        "--node-name", f"node-{i:02d}", "--port", "0")
+        self._spawn("kubernetes_tpu.proxy", "--master", self.master_url,
+                    "--port", "0")
+        self._wait_ready(timeout)
+        return self
+
+    def _wait_ready(self, timeout: float):
+        """All nodes registered and Ready through the real API."""
+        from kubernetes_tpu.utils.debugserver import client_from_url
+        client = client_from_url(self.master_url)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for proc in self.procs:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"component exited early: {proc.args} rc={proc.returncode}")
+            try:
+                nodes, _ = client.list("nodes")
+            except Exception:
+                time.sleep(0.2)
+                continue
+            ready = [n for n in nodes if any(
+                c.type == "Ready" and c.status == "True"
+                for c in ((n.status.conditions or []) if n.status else []))]
+            if len(ready) >= self.nodes:
+                return
+            time.sleep(0.2)
+        raise TimeoutError(f"cluster not ready within {timeout}s")
+
+    def stop(self):
+        for proc in reversed(self.procs):
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self.procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.procs.clear()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="localup")
+    p.add_argument("--nodes", type=int, default=2)
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--data-dir", default="")
+    p.add_argument("--tpu-backend", default="true", choices=("true", "false"))
+    a = p.parse_args(argv)
+    cluster = LocalCluster(nodes=a.nodes, port=a.port, data_dir=a.data_dir,
+                           tpu_backend=a.tpu_backend == "true")
+    cluster.start()
+    print(f"cluster up: {cluster.master_url} ({a.nodes} nodes)\n"
+          f"try: python -m kubernetes_tpu.kubectl -s {cluster.master_url} "
+          f"get nodes", flush=True)
+    stop = [False]
+    signal.signal(signal.SIGTERM, lambda *x: stop.__setitem__(0, True))
+    signal.signal(signal.SIGINT, lambda *x: stop.__setitem__(0, True))
+    try:
+        while not stop[0]:
+            time.sleep(0.5)
+    finally:
+        cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+"""kube-proxy entrypoint: python -m kubernetes_tpu.proxy
+
+Flags bind to KubeProxyConfiguration, served at /configz next to /healthz
+and /metrics (reference cmd/kube-proxy). The iptables backend is the
+in-process FakeIptables ruleset compiler (no kernel netfilter here); the
+compiled ruleset is observable via the debug endpoint for inspection."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from kubernetes_tpu.apis.componentconfig import KubeProxyConfiguration
+from kubernetes_tpu.proxy import FakeIptables, Proxier
+from kubernetes_tpu.utils.debugserver import DebugServer, client_from_url
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-proxy")
+    p.add_argument("--master", default="http://127.0.0.1:8080")
+    p.add_argument("--port", type=int, default=10249)
+    p.add_argument("--proxy-mode", default="iptables",
+                   choices=("iptables", "userspace"))
+    p.add_argument("--node-name", default="proxy-node")
+    a = p.parse_args(argv)
+    cfg = KubeProxyConfiguration(mode=a.proxy_mode)
+
+    client = client_from_url(a.master, qps=100, burst=200)
+    ipt = FakeIptables()
+    proxier = Proxier(client, ipt, node_name=a.node_name)
+    proxier.start()
+    debug = DebugServer(port=a.port,
+                        configz={"componentconfig": cfg}).start()
+    print(f"kube-proxy debug on http://127.0.0.1:{debug.port}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a_: stop.set())
+    signal.signal(signal.SIGINT, lambda *a_: stop.set())
+    stop.wait()
+    proxier.stop()
+    debug.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""kube-scheduler entrypoint: python -m kubernetes_tpu.scheduler
+
+Flags bind to KubeSchedulerConfiguration (componentconfig), served at
+/configz on the scheduler's own debug port alongside /healthz (fed by the
+kernel health state) and /metrics — the reference mux on :10251
+(plugin/cmd/kube-scheduler/app/server.go:71-181, options.go:40-74).
+
+--tpu-backend (default on) runs the batched device kernel behind the
+provider seam; off = the sequential oracle loop."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+from kubernetes_tpu.apis.componentconfig import (
+    KubeSchedulerConfiguration, LeaderElectionConfiguration,
+)
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+from kubernetes_tpu.utils.debugserver import DebugServer, client_from_url
+
+
+def build_config(argv=None) -> KubeSchedulerConfiguration:
+    p = argparse.ArgumentParser(prog="kube-scheduler")
+    p.add_argument("--master", default="http://127.0.0.1:8080")
+    p.add_argument("--port", type=int, default=10251)
+    p.add_argument("--scheduler-name", default="default-scheduler")
+    p.add_argument("--algorithm-provider", default="DefaultProvider")
+    p.add_argument("--policy-config-file", default="")
+    p.add_argument("--hard-pod-affinity-symmetric-weight", type=int, default=1)
+    p.add_argument("--kube-api-qps", type=float, default=5000.0)
+    p.add_argument("--kube-api-burst", type=int, default=5000)
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--tpu-backend", default="true",
+                   choices=("true", "false"))
+    p.add_argument("--batch-size", type=int, default=4096)
+    a = p.parse_args(argv)
+    cfg = KubeSchedulerConfiguration(
+        scheduler_name=a.scheduler_name,
+        algorithm_provider=a.algorithm_provider,
+        policy_config_file=a.policy_config_file,
+        hard_pod_affinity_symmetric_weight=a.hard_pod_affinity_symmetric_weight,
+        kube_api_qps=a.kube_api_qps, kube_api_burst=a.kube_api_burst,
+        leader_election=LeaderElectionConfiguration(leader_elect=a.leader_elect),
+        port=a.port, tpu_backend=a.tpu_backend == "true")
+    cfg.master = a.master  # not part of the versioned object in the reference
+    cfg.batch_size = a.batch_size
+    return cfg
+
+
+def build_scheduler(cfg: KubeSchedulerConfiguration, client):
+    factory = ConfigFactory(
+        client, scheduler_name=cfg.scheduler_name,
+        hard_pod_affinity_weight=cfg.hard_pod_affinity_symmetric_weight)
+    factory.run()
+    if cfg.policy_config_file:
+        with open(cfg.policy_config_file, encoding="utf-8") as f:
+            policy = json.load(f)
+        sched = factory.create_from_policy(policy)
+    elif cfg.tpu_backend:
+        sched = factory.create_batch_from_provider(
+            cfg.algorithm_provider, batch_size=getattr(cfg, "batch_size", 4096))
+    else:
+        sched = factory.create_from_provider(cfg.algorithm_provider)
+    return factory, sched
+
+
+def main(argv=None) -> int:
+    cfg = build_config(argv)
+    client = client_from_url(cfg.master, qps=cfg.kube_api_qps,
+                             burst=cfg.kube_api_burst)
+    factory, sched = build_scheduler(cfg, client)
+    debug = DebugServer(
+        port=cfg.port,
+        healthz=lambda: (sched.healthy() if hasattr(sched, "healthy")
+                         else True),
+        configz={"componentconfig": cfg}).start()
+    print(f"scheduler debug on http://127.0.0.1:{debug.port}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    if cfg.leader_election and cfg.leader_election.leader_elect:
+        from kubernetes_tpu.client.leaderelection import (
+            LeaderElectionConfig, LeaderElector,
+        )
+        import os
+        elector = LeaderElector(
+            client, LeaderElectionConfig(
+                lock_name="kube-scheduler",
+                identity=f"{cfg.scheduler_name}-{os.getpid()}"),
+            on_started_leading=lambda: sched.run(),
+            on_stopped_leading=lambda: stop.set())
+        elector.run()
+    else:
+        sched.run()
+    stop.wait()
+    sched.stop()
+    factory.stop()
+    debug.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

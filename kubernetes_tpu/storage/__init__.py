@@ -13,3 +13,4 @@ from kubernetes_tpu.storage.store import (
     Event, MemStore, StorageError, KeyExists, KeyNotFound, Conflict,
     TooOldResourceVersion, ADDED, MODIFIED, DELETED,
 )
+from kubernetes_tpu.storage.durable import DurableStore

@@ -1,0 +1,179 @@
+"""Durable storage: WAL + snapshot persistence behind the MemStore API.
+
+The reference's L0 is etcd — raft-replicated, versioned keys, watchable
+(pkg/storage/etcd/etcd_helper.go, api_object_versioner.go). In-process we
+keep MemStore's exact semantics (single monotonically-increasing
+resourceVersion, CAS guaranteed_update, bounded watch window with 410) and
+add crash durability the way etcd itself does locally: every mutation is
+appended to a write-ahead log before it is published, and the log is
+periodically folded into a snapshot (etcd's snapshot + WAL-compaction
+cycle, pkg/storage/etcd3/compact.go analogue for the on-disk form).
+
+Recovery = load latest snapshot, replay WAL entries with rv beyond it.
+The watch-event window deliberately does NOT survive restart: a restarted
+server serves watches from "now", clients with older resourceVersions get
+410 Gone and re-list — exactly the Reflector contract
+(pkg/client/cache/reflector.go:252), so crash-restart needs no special
+casing anywhere above L0.
+
+Layout under data_dir/:
+  snapshot.json   {"rv": N, "data": {key: [obj, rv]}}
+  wal.log         one JSON line per mutation: {"t","k","rv","o"}
+  wal.log.1       rotated segment awaiting compaction (exists only while a
+                  snapshot is in flight or after a crash mid-snapshot)
+
+Compaction never blocks the store: when the op threshold trips, the WAL is
+rotated under the lock (cheap rename), and a background thread serializes
+the state copy, fsyncs the snapshot, and deletes the old segment. A crash
+at ANY point is safe — recovery loads the newest snapshot, then replays
+wal.log.1 (if present) and wal.log, skipping entries the snapshot already
+folded. A torn final WAL line (crash mid-append) is detected and dropped.
+fsync=True makes every append durable before the write returns (etcd's
+default); tests and benches keep it off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from kubernetes_tpu.storage.store import (
+    ADDED, DELETED, MODIFIED, Event, MemStore,
+)
+
+SNAPSHOT = "snapshot.json"
+WAL = "wal.log"
+WAL_OLD = "wal.log.1"
+
+
+class DurableStore(MemStore):
+    """MemStore + WAL/snapshot persistence. Drop-in for Registry(store=...)."""
+
+    def __init__(self, data_dir: str, window: int = 4096,
+                 watcher_queue: int = 4096, fsync: bool = False,
+                 snapshot_every: int = 10000):
+        super().__init__(window=window, watcher_queue=watcher_queue)
+        self._dir = data_dir
+        self._fsync = fsync
+        self._snapshot_every = snapshot_every
+        self._ops_since_snapshot = 0
+        self._snapshotting = False
+        self.replayed = 0   # WAL entries applied during recovery
+        os.makedirs(data_dir, exist_ok=True)
+        self._recover()
+        self._wal = open(os.path.join(data_dir, WAL), "a",
+                         encoding="utf-8")
+        if os.path.exists(os.path.join(data_dir, WAL_OLD)):
+            # crash landed between WAL rotation and snapshot rename: the
+            # recovered state already folds the old segment in, so compact
+            # it away now (synchronously — no concurrency during init)
+            self._snapshotting = True
+            self._compact(self._rv, dict(self._data))
+
+    # --- recovery --------------------------------------------------------------
+
+    def _recover(self):
+        snap_path = os.path.join(self._dir, SNAPSHOT)
+        if os.path.exists(snap_path):
+            with open(snap_path, encoding="utf-8") as f:
+                snap = json.load(f)
+            self._rv = snap["rv"]
+            self._data = {k: (obj, rv) for k, (obj, rv) in
+                          snap["data"].items()}
+        # rotated-but-uncompacted segment first (crash mid-snapshot), then
+        # the live log; snapshot-covered entries are skipped by rv
+        for name in (WAL_OLD, WAL):
+            path = os.path.join(self._dir, name)
+            if not os.path.exists(path):
+                continue
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        e = json.loads(line)
+                        t, k, rv, obj = e["t"], e["k"], e["rv"], e["o"]
+                    except (json.JSONDecodeError, KeyError):
+                        break  # torn tail from a crash mid-append
+                    if rv <= self._rv:
+                        continue  # already folded into the snapshot
+                    if t == DELETED:
+                        self._data.pop(k, None)
+                    else:
+                        self._data[k] = (obj, rv)
+                    self._rv = rv
+                    self.replayed += 1
+
+    # --- persistence hook -------------------------------------------------------
+
+    def _publish(self, ev: Event):
+        # called with the store lock held, after the in-memory mutation and
+        # before any watcher sees the event: the WAL is ahead of observers
+        self._wal.write(json.dumps(
+            {"t": ev.type, "k": ev.key, "rv": ev.rv, "o": ev.obj},
+            separators=(",", ":")) + "\n")
+        self._wal.flush()
+        if self._fsync:
+            os.fsync(self._wal.fileno())
+        self._ops_since_snapshot += 1
+        if (self._ops_since_snapshot >= self._snapshot_every
+                and not self._snapshotting
+                and not os.path.exists(os.path.join(self._dir, WAL_OLD))):
+            # rotate under the lock (cheap), compact on a background thread
+            # — a full-store JSON dump must never stall the request path
+            self._snapshotting = True
+            self._ops_since_snapshot = 0
+            snap_rv, snap_data = self._rotate_wal_locked()
+            threading.Thread(
+                target=self._compact, args=(snap_rv, snap_data),
+                name="store-snapshot", daemon=True).start()
+        super()._publish(ev)
+
+    # --- snapshot / compaction ----------------------------------------------------
+
+    def _rotate_wal_locked(self):
+        """Swap in a fresh WAL segment and copy (rv, data) refs; caller
+        holds the store lock (reached from _publish)."""
+        self._wal.close()
+        os.replace(os.path.join(self._dir, WAL),
+                   os.path.join(self._dir, WAL_OLD))
+        self._wal = open(os.path.join(self._dir, WAL), "w", encoding="utf-8")
+        # shallow copy: stored objects are never mutated in place (the
+        # store deep-copies on write), so refs are stable for serialization
+        return self._rv, dict(self._data)
+
+    def _compact(self, snap_rv: int, snap_data: dict):
+        try:
+            snap = {"rv": snap_rv,
+                    "data": {k: [obj, rv] for k, (obj, rv) in
+                             snap_data.items()}}
+            tmp = os.path.join(self._dir, SNAPSHOT + ".tmp")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snap, f, separators=(",", ":"))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self._dir, SNAPSHOT))
+            # snapshot durable: the rotated segment is now redundant
+            os.remove(os.path.join(self._dir, WAL_OLD))
+        finally:
+            self._snapshotting = False
+
+    def snapshot(self):
+        """Synchronous fold (external callers / shutdown): rotate + compact
+        on the calling thread."""
+        with self._lock:
+            if self._snapshotting or os.path.exists(
+                    os.path.join(self._dir, WAL_OLD)):
+                return
+            self._snapshotting = True
+            self._ops_since_snapshot = 0
+            snap_rv, snap_data = self._rotate_wal_locked()
+        self._compact(snap_rv, snap_data)
+
+    def close(self):
+        with self._lock:
+            try:
+                self._wal.flush()
+                self._wal.close()
+            except ValueError:
+                pass  # already closed

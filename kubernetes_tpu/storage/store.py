@@ -92,6 +92,10 @@ class _Watcher:
         self.prefix = prefix
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._maxlen = maxlen
+        # the replay prefix doesn't count against the live bound: a resuming
+        # watcher near the window edge must not be dropped before its
+        # consumer even runs
+        self._grace = len(pending)
         self._stopped = False
         self.dropped = False
         for ev in pending:
@@ -104,7 +108,7 @@ class _Watcher:
     def _deliver(self, ev: Event):
         if self._stopped or not ev.key.startswith(self.prefix):
             return
-        if self._maxlen and self._q.qsize() >= self._maxlen:
+        if self._maxlen and self._q.qsize() >= self._maxlen + self._grace:
             # too far behind: cut it loose rather than block writers or
             # grow the queue without bound; the client re-lists
             self._stopped = True

@@ -1,0 +1,98 @@
+"""Per-component debug mux: /healthz, /metrics, /configz.
+
+Every reference component serves this trio on its own port (scheduler on
+:10251 — plugin/cmd/kube-scheduler/app/server.go:92-108; /configz from
+pkg/util/configz exposes the component's live versioned configuration).
+The component entrypoints (__main__ modules) mount their componentconfig
+object here, closing the round-3 finding that the config types were
+consumed by nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict, is_dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+
+class DebugServer:
+    """healthz/metrics/configz endpoint bundle for a component process."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 healthz: Optional[Callable[[], bool]] = None,
+                 configz: Optional[Dict[str, object]] = None):
+        self._host = host
+        self._port = port
+        self.healthz = healthz or (lambda: True)
+        self.configz: Dict[str, object] = dict(configz or {})
+        self._httpd = None
+        self._thread = None
+
+    def register_config(self, name: str, obj) -> None:
+        self.configz[name] = obj
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "not started"
+        return self._httpd.server_address[1]
+
+    def start(self) -> "DebugServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, body, ctype="text/plain"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/healthz", "/healthz/ping"):
+                    ok = False
+                    try:
+                        ok = outer.healthz()
+                    except Exception:
+                        pass
+                    return self._send(200 if ok else 500,
+                                      b"ok" if ok else b"unhealthy")
+                if self.path == "/metrics":
+                    return self._send(200, METRICS.render().encode())
+                if self.path == "/configz":
+                    payload = {name: (asdict(o) if is_dataclass(o) else o)
+                               for name, o in outer.configz.items()}
+                    return self._send(200, json.dumps(payload).encode(),
+                                      "application/json")
+                self._send(404, b"not found")
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="debug-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def client_from_url(url: str, **kw):
+    """RESTClient from a --master URL like http://127.0.0.1:8080."""
+    from urllib.parse import urlparse
+
+    from kubernetes_tpu.client import RESTClient
+    u = urlparse(url if "//" in url else f"http://{url}")
+    return RESTClient(host=u.hostname or "127.0.0.1", port=u.port or 8080,
+                      **kw)

@@ -1,0 +1,162 @@
+"""Durable storage: WAL + snapshot recovery (round-3 verdict #5).
+
+The kill-and-restart contract: a restarted apiserver recovers every object
+at the same resourceVersions; clients holding stale RVs get 410 and
+re-list (the Reflector contract), so nothing above L0 special-cases crash
+recovery (pkg/storage/etcd/etcd_helper.go / api_object_versioner.go
+semantics)."""
+
+import os
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.client.informer import Informer, ListWatch
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.registry.generic import Registry
+from kubernetes_tpu.storage import Conflict, DurableStore
+
+
+class TestRecovery:
+    def test_restart_recovers_objects_and_rv(self, tmp_path):
+        d = str(tmp_path)
+        s = DurableStore(d)
+        s.create("/pods/default/a", {"v": 1})
+        rv_b = s.create("/pods/default/b", {"v": 2})
+        s.update("/pods/default/a", {"v": 10})
+        s.delete("/pods/default/b", expect_rv=rv_b)
+        rv = s.current_rv
+        s.close()
+
+        r = DurableStore(d)
+        assert r.current_rv == rv
+        obj, orv = r.get("/pods/default/a")
+        assert obj == {"v": 10}
+        with pytest.raises(Exception):
+            r.get("/pods/default/b")
+        # writes continue from the recovered rv, monotonic
+        assert r.create("/pods/default/c", {"v": 3}) == rv + 1
+        r.close()
+
+    def test_snapshot_truncates_wal_and_recovers(self, tmp_path):
+        import time
+        d = str(tmp_path)
+        s = DurableStore(d, snapshot_every=10)
+        for i in range(25):   # crosses snapshot boundaries
+            s.create(f"/k/{i:02d}", {"i": i})
+        # compaction is asynchronous: wait for quiescence
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+                s._snapshotting
+                or os.path.exists(os.path.join(d, "wal.log.1"))):
+            time.sleep(0.02)
+        assert os.path.exists(os.path.join(d, "snapshot.json"))
+        wal_lines = open(os.path.join(d, "wal.log")).read().splitlines()
+        assert len(wal_lines) < 25  # the log was compacted at least once
+        s.close()
+
+        r = DurableStore(d)
+        items, rv = r.list("/k/")
+        assert len(items) == 25 and rv == 25
+        assert r.replayed == len(wal_lines)
+        r.close()
+
+    def test_crash_between_rotate_and_snapshot_loses_nothing(self, tmp_path):
+        d = str(tmp_path)
+        s = DurableStore(d)
+        for i in range(6):
+            s.create(f"/k/{i}", {"i": i})
+        s.close()
+        # simulate the crash window: WAL rotated, snapshot never written
+        os.replace(os.path.join(d, "wal.log"), os.path.join(d, "wal.log.1"))
+        open(os.path.join(d, "wal.log"), "w").close()
+        r = DurableStore(d)
+        assert r.count("/k/") == 6 and r.current_rv == 6
+        # init folded the stale segment into a fresh snapshot
+        assert not os.path.exists(os.path.join(d, "wal.log.1"))
+        assert os.path.exists(os.path.join(d, "snapshot.json"))
+        r.close()
+        r2 = DurableStore(d)   # and it stays recoverable
+        assert r2.count("/k/") == 6
+        r2.close()
+
+    def test_torn_wal_tail_is_dropped(self, tmp_path):
+        d = str(tmp_path)
+        s = DurableStore(d)
+        s.create("/k/good", {"v": 1})
+        s.close()
+        with open(os.path.join(d, "wal.log"), "a") as f:
+            f.write('{"t":"ADDED","k":"/k/torn","rv":2,"o":{"v')  # crash
+        r = DurableStore(d)
+        assert r.count("/k/") == 1
+        assert r.current_rv == 1
+        r.close()
+
+    def test_cas_semantics_preserved(self, tmp_path):
+        s = DurableStore(str(tmp_path))
+        rv = s.create("/k/x", {"n": 0})
+        with pytest.raises(Conflict):
+            s.update("/k/x", {"n": 1}, expect_rv=rv + 5)
+        s.guaranteed_update("/k/x", lambda obj, _rv: {"n": obj["n"] + 1})
+        assert s.get("/k/x")[0] == {"n": 1}
+        s.close()
+
+
+def mk_pod(name):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="pause")]))
+
+
+class TestKillAndRestartE2E:
+    def test_apiserver_recovers_and_stale_watch_gets_410(self, tmp_path):
+        d = str(tmp_path)
+        server = APIServer(Registry(DurableStore(d))).start()
+        client = RESTClient.for_server(server, qps=1000, burst=1000)
+        for i in range(20):
+            client.create("pods", mk_pod(f"p-{i:02d}"))
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="n-0"),
+            status=api.NodeStatus(allocatable={"cpu": "4"})))
+        _, rv_before = client.list("pods", "default")
+        server.registry.store.close()
+        server.stop()   # kill
+
+        # restart on the same data dir
+        server2 = APIServer(Registry(DurableStore(d))).start()
+        try:
+            client2 = RESTClient.for_server(server2, qps=1000, burst=1000)
+            pods, rv_after = client2.list("pods", "default")
+            assert len(pods) == 20
+            assert int(rv_after) == int(rv_before)
+            nodes, _ = client2.list("nodes")
+            assert [n.metadata.name for n in nodes] == ["n-0"]
+
+            # a watcher resuming from a pre-restart RV: the event window
+            # died with the process -> 410 Gone -> client re-lists
+            with pytest.raises(ApiError) as ei:
+                stream = client2.watch("pods", "default",
+                                       resource_version=1)
+                next(iter(stream))
+            assert ei.value.is_gone
+
+            # the Reflector does that dance automatically and converges
+            inf = Informer(ListWatch(client2, "pods"))
+            inf.run()
+            assert inf.wait_for_sync(10)
+            assert len(inf.store.list()) == 20
+            # and new writes keep flowing to it
+            client2.create("pods", mk_pod("post-restart"))
+            import time
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(inf.store.list()) == 21:
+                    break
+                time.sleep(0.05)
+            assert len(inf.store.list()) == 21
+            inf.stop()
+        finally:
+            server2.registry.store.close()
+            server2.stop()

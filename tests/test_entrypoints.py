@@ -1,0 +1,90 @@
+"""Component entrypoints + localup: a real multi-process cluster
+(round-3 verdict #6).
+
+Every component boots as its own OS process via `python -m`, flags bound
+to componentconfig objects served live at /configz, and kubectl (also a
+subprocess) drives the cluster end to end — the local-up-cluster.sh
+experience (reference plugin/cmd/* binaries + hack/local-up-cluster.sh)."""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.localup import LocalCluster
+
+
+def kubectl(master, *args):
+    out = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.kubectl", "-s", master, *args],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+@pytest.mark.slow
+def test_multiprocess_cluster_end_to_end(tmp_path):
+    cluster = LocalCluster(nodes=2, port=0,
+                           data_dir=str(tmp_path / "apiserver"))
+    cluster.start(timeout=90)
+    try:
+        master = cluster.master_url
+
+        # kubectl sees both hollow nodes Ready
+        out = kubectl(master, "get", "nodes")
+        assert "node-00" in out and "node-01" in out
+
+        # /configz serves the live componentconfig on the apiserver
+        with urllib.request.urlopen(f"{master}/configz", timeout=10) as r:
+            configz = json.loads(r.read())
+        assert configz["apiserver"]["data_dir"].endswith("apiserver")
+        assert configz["apiserver"]["max_in_flight"] == 400
+
+        # create a pod via kubectl -f; the out-of-process scheduler binds
+        # it and the kubelet runs it
+        manifest = tmp_path / "pod.json"
+        manifest.write_text(json.dumps({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "hello", "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "image": "pause",
+                "resources": {"requests": {"cpu": "100m",
+                                           "memory": "64Mi"}}}]},
+        }))
+        kubectl(master, "create", "-f", str(manifest))
+
+        deadline = time.monotonic() + 60
+        phase = ""
+        while time.monotonic() < deadline:
+            out = kubectl(master, "get", "pods")
+            if "Running" in out:
+                phase = "Running"
+                break
+            time.sleep(0.5)
+        assert phase == "Running", out
+
+        # scale via a deployment-less RC path: kubectl run creates an RC,
+        # the controller-manager (separate process) stamps replicas
+        kubectl(master, "run", "web", "--image=pause", "--replicas=3")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            out = kubectl(master, "get", "pods")
+            if out.count("Running") >= 4:  # hello + 3 replicas
+                break
+            time.sleep(0.5)
+        assert out.count("Running") >= 4, out
+    finally:
+        cluster.stop()
+
+    # durability across a full cluster restart: same data-dir, objects back
+    cluster2 = LocalCluster(nodes=2, port=0,
+                            data_dir=str(tmp_path / "apiserver"))
+    cluster2.start(timeout=90)
+    try:
+        out = kubectl(cluster2.master_url, "get", "pods")
+        assert "hello" in out
+    finally:
+        cluster2.stop()
