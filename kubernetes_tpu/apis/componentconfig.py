@@ -28,10 +28,12 @@ class KubeSchedulerConfiguration:
     kube_api_burst: int = 100
     leader_election: Optional["LeaderElectionConfiguration"] = None
     port: int = 10251
+    master: str = "http://127.0.0.1:8080"
     # TPU decision plane (no reference analog): enable the batched kernel
     # and its shapes
     tpu_backend: bool = False
     tpu_batch_window_ms: int = 50
+    batch_size: int = 4096
 
 
 @dataclass

@@ -244,10 +244,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_plain(200, METRICS.render().encode())
         if url.path == "/configz":
             # live component configuration (pkg/util/configz)
-            from dataclasses import asdict, is_dataclass
-            payload = {name: (asdict(o) if is_dataclass(o) else o)
-                       for name, o in self.server_ref.configz.items()}
-            return self._send_json(200, payload)
+            from kubernetes_tpu.utils.debugserver import render_configz
+            return self._send_json(200,
+                                   render_configz(self.server_ref.configz))
 
         if url.path == "/api":
             return self._send_json(200, {"kind": "APIVersions",

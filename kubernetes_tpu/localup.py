@@ -27,17 +27,28 @@ class LocalCluster:
         self.master_url: Optional[str] = None
         self.procs: List[subprocess.Popen] = []
 
-    def _spawn(self, *args) -> subprocess.Popen:
+    def _spawn(self, *args, pipe_stdout: bool = False) -> subprocess.Popen:
+        # only the apiserver's stdout is ever read (its one banner line);
+        # piping the others would deadlock them once the pipe buffer fills
         proc = subprocess.Popen(
             [sys.executable, "-m", *args],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+            stdout=subprocess.PIPE if pipe_stdout else subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, text=True)
         self.procs.append(proc)
         return proc
 
     def start(self, timeout: float = 60.0) -> "LocalCluster":
+        try:
+            return self._start(timeout)
+        except BaseException:
+            self.stop()  # never leak half-started components
+            raise
+
+    def _start(self, timeout: float) -> "LocalCluster":
         apiserver = self._spawn(
             "kubernetes_tpu.apiserver", "--port", str(self.port),
-            *(["--data-dir", self.data_dir] if self.data_dir else []))
+            *(["--data-dir", self.data_dir] if self.data_dir else []),
+            pipe_stdout=True)
         # the apiserver prints its bound address (works with --port 0)
         line = apiserver.stdout.readline()
         if "listening on " not in line:

@@ -1437,7 +1437,7 @@ class IncrementalTensorizer:
         """build + device sync + kernel; returns node name (or None) per
         pending pod, FIFO order — drop-in for scheduler.batch.tpu_batch."""
         from kubernetes_tpu.ops.kernel import (
-            Weights, _schedule_jit, features_of,
+            Weights, _schedule_jit, assignments_to_names, features_of,
         )
         weights = weights or Weights()
         with self._lock:
@@ -1445,9 +1445,4 @@ class IncrementalTensorizer:
             arrays = self.device_sync(ct, device=device)
             n_zones, feats = ct.n_zones, features_of(ct)
         out = np.asarray(_schedule_jit(arrays, n_zones, weights, feats))
-        result: List[Optional[str]] = []
-        for i in range(ct.n_real_pods):
-            n = int(out[i])
-            name = ct.node_names[n] if 0 <= n < len(ct.node_names) else ""
-            result.append(name or None)
-        return result
+        return assignments_to_names(out, ct)

@@ -462,6 +462,21 @@ def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
     return greedy_commit(t, s, weights, feats)
 
 
+def assignments_to_names(out: np.ndarray,
+                         ct: ClusterTensors) -> List[Optional[str]]:
+    """Decode kernel output ([P] node indices, -1 = unschedulable) to node
+    names — the ONE decoder shared by the unsharded, sharded, and
+    incremental paths, so equivalence tests compare kernels, not decoders.
+    Handles both dense node_names (full Tensorizer) and slot-indexed lists
+    with empty holes (incremental mirror)."""
+    result: List[Optional[str]] = []
+    for i in range(ct.n_real_pods):
+        n = int(out[i])
+        name = ct.node_names[n] if 0 <= n < len(ct.node_names) else ""
+        result.append(name or None)
+    return result
+
+
 def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
                    device=None) -> List[Optional[str]]:
     """Schedule a tensorized batch; returns node name (or None) per pending
@@ -472,8 +487,4 @@ def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
     if device is not None:
         arrays = jax.device_put(arrays, device)
     out = np.asarray(_schedule_jit(arrays, ct.n_zones, weights, feats))
-    result: List[Optional[str]] = []
-    for i in range(ct.n_real_pods):
-        n = int(out[i])
-        result.append(ct.node_names[n] if 0 <= n < ct.n_real_nodes else None)
-    return result
+    return assignments_to_names(out, ct)

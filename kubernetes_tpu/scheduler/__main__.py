@@ -45,9 +45,8 @@ def build_config(argv=None) -> KubeSchedulerConfiguration:
         hard_pod_affinity_symmetric_weight=a.hard_pod_affinity_symmetric_weight,
         kube_api_qps=a.kube_api_qps, kube_api_burst=a.kube_api_burst,
         leader_election=LeaderElectionConfiguration(leader_elect=a.leader_elect),
-        port=a.port, tpu_backend=a.tpu_backend == "true")
-    cfg.master = a.master  # not part of the versioned object in the reference
-    cfg.batch_size = a.batch_size
+        port=a.port, master=a.master, tpu_backend=a.tpu_backend == "true",
+        batch_size=a.batch_size)
     return cfg
 
 
@@ -62,7 +61,7 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, client):
         sched = factory.create_from_policy(policy)
     elif cfg.tpu_backend:
         sched = factory.create_batch_from_provider(
-            cfg.algorithm_provider, batch_size=getattr(cfg, "batch_size", 4096))
+            cfg.algorithm_provider, batch_size=cfg.batch_size)
     else:
         sched = factory.create_from_provider(cfg.algorithm_provider)
     return factory, sched

@@ -35,9 +35,12 @@ default); tests and benches keep it off.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from typing import Optional
+
+_log = logging.getLogger("storage.durable")
 
 from kubernetes_tpu.storage.store import (
     ADDED, DELETED, MODIFIED, Event, MemStore,
@@ -117,13 +120,17 @@ class DurableStore(MemStore):
             os.fsync(self._wal.fileno())
         self._ops_since_snapshot += 1
         if (self._ops_since_snapshot >= self._snapshot_every
-                and not self._snapshotting
-                and not os.path.exists(os.path.join(self._dir, WAL_OLD))):
+                and not self._snapshotting):
             # rotate under the lock (cheap), compact on a background thread
             # — a full-store JSON dump must never stall the request path
             self._snapshotting = True
             self._ops_since_snapshot = 0
-            snap_rv, snap_data = self._rotate_wal_locked()
+            if os.path.exists(os.path.join(self._dir, WAL_OLD)):
+                # a previous compaction failed and left its segment: compact
+                # the CURRENT state (it covers both segments), no rotation
+                snap_rv, snap_data = self._rv, dict(self._data)
+            else:
+                snap_rv, snap_data = self._rotate_wal_locked()
             threading.Thread(
                 target=self._compact, args=(snap_rv, snap_data),
                 name="store-snapshot", daemon=True).start()
@@ -153,21 +160,32 @@ class DurableStore(MemStore):
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self._dir, SNAPSHOT))
-            # snapshot durable: the rotated segment is now redundant
-            os.remove(os.path.join(self._dir, WAL_OLD))
+            # snapshot durable: any rotated segment is now redundant
+            try:
+                os.remove(os.path.join(self._dir, WAL_OLD))
+            except FileNotFoundError:
+                pass
+        except Exception:
+            # disk-full etc: data stays safe (segments remain), the next
+            # threshold retries via the salvage path — but say so loudly
+            _log.exception("snapshot compaction failed; WAL keeps growing "
+                           "until a retry succeeds")
         finally:
             self._snapshotting = False
 
     def snapshot(self):
         """Synchronous fold (external callers / shutdown): rotate + compact
-        on the calling thread."""
+        on the calling thread; salvages a failed prior compaction's segment
+        the same way the threshold path does."""
         with self._lock:
-            if self._snapshotting or os.path.exists(
-                    os.path.join(self._dir, WAL_OLD)):
+            if self._snapshotting:
                 return
             self._snapshotting = True
             self._ops_since_snapshot = 0
-            snap_rv, snap_data = self._rotate_wal_locked()
+            if os.path.exists(os.path.join(self._dir, WAL_OLD)):
+                snap_rv, snap_data = self._rv, dict(self._data)
+            else:
+                snap_rv, snap_data = self._rotate_wal_locked()
         self._compact(snap_rv, snap_data)
 
     def close(self):

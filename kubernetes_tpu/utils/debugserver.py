@@ -19,6 +19,12 @@ from typing import Callable, Dict, Optional
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 
+def render_configz(configz: Dict[str, object]) -> dict:
+    """JSON-ready /configz payload (shared with the apiserver's route)."""
+    return {name: (asdict(o) if is_dataclass(o) else o)
+            for name, o in configz.items()}
+
+
 class DebugServer:
     """healthz/metrics/configz endpoint bundle for a component process."""
 
@@ -68,8 +74,7 @@ class DebugServer:
                 if self.path == "/metrics":
                     return self._send(200, METRICS.render().encode())
                 if self.path == "/configz":
-                    payload = {name: (asdict(o) if is_dataclass(o) else o)
-                               for name, o in outer.configz.items()}
+                    payload = render_configz(outer.configz)
                     return self._send(200, json.dumps(payload).encode(),
                                       "application/json")
                 self._send(404, b"not found")
