@@ -1,0 +1,49 @@
+"""Memory-pressure eviction (reference pkg/kubelet/eviction).
+
+When the stats provider reports memory pressure, the manager:
+- flips the node's MemoryPressure condition True (the scheduler's
+  CheckNodeMemoryPressure predicate then keeps new BestEffort pods away);
+- evicts ONE victim per observation interval, ranked by QoS class —
+  BestEffort before Burstable before Guaranteed, oldest first within a
+  class (eviction/helpers.go qos ordering): pod phase Failed with reason
+  "Evicted", containers killed.
+
+Pressure clearing flips the condition back. One-victim-per-interval is the
+reference's pressure-relief pacing (the manager re-observes between kills).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.kubelet.qos import EVICTION_ORDER, qos_class
+
+log = logging.getLogger("kubelet.eviction")
+
+EVICTED_REASON = "Evicted"
+
+
+class EvictionManager:
+    def __init__(self, cadvisor, runtime):
+        self.cadvisor = cadvisor
+        self.runtime = runtime
+        self.under_pressure = False
+
+    def observe(self) -> Optional[str]:
+        """One interval: update pressure state; return the pod key to evict
+        (or None). The kubelet owns the status/event writes."""
+        self.under_pressure = bool(self.cadvisor.under_memory_pressure())
+        if not self.under_pressure:
+            return None
+        victims = self._ranked()
+        return victims[0] if victims else None
+
+    def _ranked(self) -> List[str]:
+        entries = []
+        for key, rp in self.runtime.running().items():
+            entries.append((EVICTION_ORDER.get(qos_class(rp.pod), 2),
+                            rp.started_at, key))
+        entries.sort()
+        return [key for _, _, key in entries]

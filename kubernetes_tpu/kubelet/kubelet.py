@@ -20,6 +20,9 @@ from kubernetes_tpu.api.serialization import deep_copy
 from kubernetes_tpu.client import Informer, ListWatch, RESTClient
 from kubernetes_tpu.client.record import EventRecorder
 from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.kubelet.eviction import EVICTED_REASON, EvictionManager
+from kubernetes_tpu.kubelet.pleg import CONTAINER_DIED, PLEG
+from kubernetes_tpu.kubelet.probe import ProbeManager
 from kubernetes_tpu.kubelet.runtime import FakeCadvisor, FakeRuntime, PodRuntime
 from kubernetes_tpu.scheduler.cache import NodeInfo
 from kubernetes_tpu.scheduler.predicates import PredicateFailure, general_predicates
@@ -34,6 +37,7 @@ class Kubelet:
                  cadvisor: Optional[FakeCadvisor] = None,
                  heartbeat_period: float = 10.0,
                  sync_period: float = 1.0,
+                 eviction_period: float = 2.0,
                  node_labels: Optional[Dict[str, str]] = None,
                  pod_ip_base: str = "10.0"):
         self.client = client
@@ -42,14 +46,19 @@ class Kubelet:
         self.cadvisor = cadvisor or FakeCadvisor()
         self.heartbeat_period = heartbeat_period
         self.sync_period = sync_period
+        self.eviction_period = eviction_period
         self.node_labels = dict(node_labels or {})
         self.node_labels.setdefault(api.LABEL_HOSTNAME, node_name)
         self.recorder = EventRecorder(client, "kubelet", source_host=node_name)
         self._pod_ip_base = pod_ip_base
         self._ip_counter = 0
-        self._statuses: Dict[str, str] = {}  # key -> last phase written
+        self._statuses: Dict[str, tuple] = {}  # key -> last written signature
+        self._ready: Dict[str, bool] = {}      # key -> last probed readiness
         self._stop = threading.Event()
         self._threads = []
+        self.probes = ProbeManager(self.runtime)
+        self.pleg = PLEG(self.runtime)
+        self.eviction = EvictionManager(self.cadvisor, self.runtime)
         # pod source: apiserver watch filtered to me (config/apiserver.go:29)
         self.pod_informer = Informer(ListWatch(
             client, "pods",
@@ -83,15 +92,24 @@ class Kubelet:
                 raise
 
     def heartbeat(self):
-        """Refresh the Ready condition (node status update loop)."""
+        """Refresh the Ready + MemoryPressure conditions (node status
+        update loop; MemoryPressure fed by the eviction manager)."""
         try:
             node = self.client.get("nodes", self.node_name)
         except ApiError:
             return
         node.status = node.status or api.NodeStatus()
         conds = [c for c in (node.status.conditions or [])
-                 if c.type != api.NODE_READY]
+                 if c.type not in (api.NODE_READY, api.NODE_MEMORY_PRESSURE)]
         conds.append(_ready_condition())
+        conds.append(api.NodeCondition(
+            type=api.NODE_MEMORY_PRESSURE,
+            status=(api.CONDITION_TRUE if self.eviction.under_pressure
+                    else api.CONDITION_FALSE),
+            reason=("KubeletHasInsufficientMemory"
+                    if self.eviction.under_pressure
+                    else "KubeletHasSufficientMemory"),
+            last_heartbeat_time=now_iso()))
         node.status.conditions = conds
         try:
             self.client.update_status("nodes", node)
@@ -125,7 +143,13 @@ class Kubelet:
             self.runtime.sync_pod(pod)
             self.recorder.event(pod, "Normal", "Started",
                                 f"Started pod {pod.metadata.name}")
-        self._set_status(pod, api.POD_RUNNING)
+            # pods with readiness probes start unready until the first
+            # success; afterwards the probe loop owns this bit
+            has_readiness = any(c.readiness_probe
+                                for c in (pod.spec.containers or []) if c)
+            self._ready.setdefault(key, not has_readiness)
+        self._set_status(pod, api.POD_RUNNING,
+                         ready=self._ready.get(key, True))
 
     def _admit(self, pod: api.Pod) -> Optional[str]:
         """Node-side re-check of GeneralPredicates (canAdmitPod; the kubelet
@@ -144,9 +168,13 @@ class Kubelet:
         return None
 
     def _set_status(self, pod: api.Pod, phase: str, reason: str = "",
-                    message: str = ""):
+                    message: str = "", ready: bool = True):
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
-        if self._statuses.get(key) == phase:
+        running = self.runtime.running().get(key)
+        restarts = tuple(sorted(running.restart_counts.items())) \
+            if running else ()
+        sig = (phase, reason, ready, restarts)
+        if self._statuses.get(key) == sig:
             return
         fresh = deep_copy(pod)
         fresh.metadata.resource_version = ""  # unconditional status write
@@ -163,23 +191,27 @@ class Kubelet:
             fresh.status.start_time = fresh.status.start_time or now_iso()
             conds = [c for c in (fresh.status.conditions or [])
                      if c.type != api.POD_READY]
-            conds.append(api.PodCondition(type=api.POD_READY,
-                                          status=api.CONDITION_TRUE,
-                                          last_transition_time=now_iso()))
+            conds.append(api.PodCondition(
+                type=api.POD_READY,
+                status=api.CONDITION_TRUE if ready else api.CONDITION_FALSE,
+                reason="" if ready else "ContainersNotReady",
+                last_transition_time=now_iso()))
             fresh.status.conditions = conds
-            running = self.runtime.running().get(key)
             if running:
+                states = self.runtime.container_states(key)
                 fresh.status.container_statuses = [
                     api.ContainerStatus(
-                        name=c.name, ready=True, image=c.image,
-                        container_id=cid,
+                        name=c.name,
+                        ready=ready and states.get(c.name) == "running",
+                        image=c.image, container_id=cid,
+                        restart_count=running.restart_counts.get(c.name, 0),
                         state=api.ContainerState(
                             running=api.ContainerStateRunning(started_at=now_iso())))
                     for c, cid in zip(fresh.spec.containers or [],
                                       running.container_ids)]
         try:
             self.client.update_status("pods", fresh)
-            self._statuses[key] = phase
+            self._statuses[key] = sig
         except ApiError as e:
             if not e.is_not_found:
                 log.warning("status update for %s failed: %s", key, e)
@@ -187,16 +219,80 @@ class Kubelet:
     def _pod_deleted(self, pod: api.Pod):
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
         self.runtime.kill_pod(key)
+        self.probes.forget_pod(key)
         self._statuses.pop(key, None)
+        self._ready.pop(key, None)
 
     def _resync(self):
-        """PLEG-style relist: kill runtime pods no longer desired, re-assert
-        status for desired pods (pleg/generic.go:180 diffing)."""
-        desired = {k for k in (f"{p.metadata.namespace}/{p.metadata.name}"
-                               for p in self.pod_informer.store.list())}
+        """Desired-state reconcile (kill runtime pods no longer desired)
+        plus the PLEG relist + probe step — the syncLoopIteration sources
+        (kubelet.go:2619) collapsed onto one periodic tick."""
+        desired = {}
+        for p in self.pod_informer.store.list():
+            desired[f"{p.metadata.namespace}/{p.metadata.name}"] = p
         for key in list(self.runtime.running()):
             if key not in desired:
                 self.runtime.kill_pod(key)
+                self.probes.forget_pod(key)
+
+        # PLEG: container deaths -> restart policy (pleg/generic.go:180)
+        for ev in self.pleg.relist():
+            if ev.type != CONTAINER_DIED:
+                continue
+            pod = desired.get(ev.pod_key)
+            if pod is None:
+                continue
+            policy = (pod.spec.restart_policy or "Always") if pod.spec else "Always"
+            if policy in ("Always", "OnFailure"):
+                self.runtime.restart_container(ev.pod_key, ev.container)
+                self.probes.forget_container(ev.pod_key, ev.container)
+                self.recorder.event(
+                    pod, "Normal", "Started",
+                    f"Restarted container {ev.container}")
+                # the probe loop below writes the status (restart_counts
+                # changed its signature) with probe-derived readiness
+            else:  # Never: terminated containers end the pod
+                self.runtime.kill_pod(ev.pod_key)
+                self.probes.forget_pod(ev.pod_key)
+                self._set_status(pod, api.POD_FAILED,
+                                 reason="ContainersDied",
+                                 message=f"container {ev.container} died "
+                                         f"(restartPolicy=Never)")
+
+        # probes: readiness feeds POD_READY; liveness failures kill (the
+        # next relist restarts per policy)
+        for key, rp in self.runtime.running().items():
+            pod = desired.get(key)
+            if pod is None:
+                continue
+            ready, kill = self.probes.step(pod)
+            for cname in kill:
+                self.recorder.event(
+                    pod, "Warning", "Unhealthy",
+                    f"Liveness probe failed for {cname}; restarting")
+                self.runtime.kill_container(key, cname)
+            self._ready[key] = ready
+            self._set_status(pod, api.POD_RUNNING, ready=ready)
+
+    def _eviction_tick(self):
+        """Memory-pressure observation + at most one eviction per interval
+        (pkg/kubelet/eviction manager loop)."""
+        was = self.eviction.under_pressure
+        victim = self.eviction.observe()
+        if self.eviction.under_pressure != was:
+            self.heartbeat()  # flip MemoryPressure promptly
+        if victim is None:
+            return
+        rp = self.runtime.running().get(victim)
+        if rp is None:
+            return
+        pod = rp.pod
+        self.recorder.event(pod, "Warning", EVICTED_REASON,
+                            "The node was low on resource: memory.")
+        self.runtime.kill_pod(victim)
+        self.probes.forget_pod(victim)
+        self._set_status(pod, api.POD_FAILED, reason=EVICTED_REASON,
+                         message="Pod evicted due to memory pressure")
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -207,7 +303,9 @@ class Kubelet:
         self.pod_informer.wait_for_sync()
         for name, target, period in (
                 ("kubelet-heartbeat", self.heartbeat, self.heartbeat_period),
-                ("kubelet-resync", self._resync, self.sync_period)):
+                ("kubelet-resync", self._resync, self.sync_period),
+                ("kubelet-eviction", self._eviction_tick,
+                 self.eviction_period)):
             t = threading.Thread(target=self._periodic, args=(target, period),
                                  name=name, daemon=True)
             t.start()

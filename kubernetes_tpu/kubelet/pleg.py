@@ -1,0 +1,50 @@
+"""PLEG: Pod Lifecycle Event Generator (reference pkg/kubelet/pleg/generic.go).
+
+Periodically relists the runtime's container states and diffs them against
+the previous relist: a container observed running->dead yields a
+ContainerDied event (generic.go:180's computeEvent). The kubelet consumes
+the events to drive restart policy instead of rescanning every pod every
+tick — the reference's reason for PLEG's existence at 100+ pods/node."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+CONTAINER_DIED = "ContainerDied"
+CONTAINER_STARTED = "ContainerStarted"
+POD_GONE = "PodGone"
+
+
+@dataclass(frozen=True)
+class PodLifecycleEvent:
+    pod_key: str
+    type: str
+    container: str = ""
+
+
+class PLEG:
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self._last: Dict[str, Dict[str, str]] = {}
+
+    def relist(self) -> List[PodLifecycleEvent]:
+        events: List[PodLifecycleEvent] = []
+        current: Dict[str, Dict[str, str]] = {}
+        for key in self.runtime.running():
+            current[key] = self.runtime.container_states(key)
+        for key, states in current.items():
+            old = self._last.get(key, {})
+            for cname, state in states.items():
+                was = old.get(cname, "")
+                if state == "dead" and was != "dead":
+                    events.append(PodLifecycleEvent(key, CONTAINER_DIED,
+                                                    cname))
+                elif state == "running" and was == "dead":
+                    events.append(PodLifecycleEvent(key, CONTAINER_STARTED,
+                                                    cname))
+        for key in self._last:
+            if key not in current:
+                events.append(PodLifecycleEvent(key, POD_GONE))
+        self._last = current
+        return events

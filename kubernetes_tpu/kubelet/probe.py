@@ -1,0 +1,136 @@
+"""Liveness/readiness probing (reference pkg/kubelet/prober + pkg/probe).
+
+Handlers:
+- exec: delegated to the runtime (FakeRuntime consults its per-container
+  exec-result table — the hollow analogue of running the command);
+- httpGet: a real HTTP GET (2xx/3xx = healthy), like pkg/probe/http;
+- tcpSocket: a real connect attempt, like pkg/probe/tcp.
+
+The ProbeManager steps every worker from the kubelet's sync tick (one
+thread for all probes — thread-per-worker doesn't scale to hollow fleets),
+honoring each probe's initialDelay/period/thresholds. Readiness results
+feed the POD_READY condition; a liveness failure past failureThreshold
+kills the container, and the PLEG relist then restarts it per
+restartPolicy with the restart count incremented
+(pkg/kubelet/prober/worker.go semantics).
+"""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from kubernetes_tpu.api import types as api
+
+
+def run_probe(probe: api.Probe, pod: api.Pod, container: api.Container,
+              runtime) -> bool:
+    """One probe attempt -> healthy?"""
+    key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+    if probe.exec and probe.exec.command is not None:
+        return runtime.exec_probe(key, container.name,
+                                  probe.exec.command) == 0
+    if probe.http_get is not None:
+        g = probe.http_get
+        host = g.host or (pod.status.pod_ip if pod.status else "") \
+            or "127.0.0.1"
+        try:
+            conn = http.client.HTTPConnection(
+                host, int(g.port or 80), timeout=probe.timeout_seconds or 1)
+            conn.request("GET", g.path or "/")
+            code = conn.getresponse().status
+            conn.close()
+            return 200 <= code < 400
+        except OSError:
+            return False
+    if probe.tcp_socket is not None:
+        host = (pod.status.pod_ip if pod.status else "") or "127.0.0.1"
+        try:
+            with socket.create_connection(
+                    (host, int(probe.tcp_socket.port or 0)),
+                    timeout=probe.timeout_seconds or 1):
+                return True
+        except OSError:
+            return False
+    return True  # no handler = always healthy (reference: nil probe)
+
+
+@dataclass
+class _Worker:
+    probe: api.Probe
+    kind: str                   # "liveness" | "readiness"
+    started: float = field(default_factory=time.monotonic)
+    next_due: float = 0.0
+    successes: int = 0
+    failures: int = 0
+    # readiness starts False until the first success; liveness starts ok
+    result: Optional[bool] = None
+
+    def healthy(self, default: bool) -> bool:
+        if self.result is None:
+            return default
+        return self.result
+
+
+class ProbeManager:
+    """Per-(pod, container, kind) probe workers, stepped from one loop."""
+
+    def __init__(self, runtime, clock=time.monotonic):
+        self.runtime = runtime
+        self._clock = clock
+        self._workers: Dict[Tuple[str, str, str], _Worker] = {}
+
+    def _worker(self, key, cname, kind, probe) -> _Worker:
+        wk = self._workers.get((key, cname, kind))
+        if wk is None:
+            wk = _Worker(probe=probe, kind=kind, started=self._clock())
+            wk.next_due = wk.started + (probe.initial_delay_seconds or 0)
+            self._workers[(key, cname, kind)] = wk
+        return wk
+
+    def forget_pod(self, key: str):
+        for wkey in [w for w in self._workers if w[0] == key]:
+            del self._workers[wkey]
+
+    def forget_container(self, key: str, cname: str):
+        """Container restarted: probe state starts over (initialDelay)."""
+        for wkey in [w for w in self._workers
+                     if w[0] == key and w[1] == cname]:
+            del self._workers[wkey]
+
+    def step(self, pod: api.Pod) -> Tuple[bool, list]:
+        """Run due probes for one running pod.
+
+        Returns (all_containers_ready, [containers to kill for liveness])."""
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        now = self._clock()
+        ready = True
+        kill = []
+        for c in (pod.spec.containers or []) if pod.spec else []:
+            for kind, probe in (("liveness", c.liveness_probe),
+                                ("readiness", c.readiness_probe)):
+                if probe is None:
+                    continue
+                wk = self._worker(key, c.name, kind, probe)
+                if now >= wk.next_due:
+                    ok = run_probe(probe, pod, c, self.runtime)
+                    wk.next_due = now + (probe.period_seconds or 10)
+                    if ok:
+                        wk.successes += 1
+                        wk.failures = 0
+                        if wk.successes >= (probe.success_threshold or 1):
+                            wk.result = True
+                    else:
+                        wk.failures += 1
+                        wk.successes = 0
+                        if wk.failures >= (probe.failure_threshold or 3):
+                            wk.result = False
+                if kind == "readiness":
+                    # unready until the first success (prober/worker.go)
+                    ready = ready and wk.healthy(default=False)
+                elif not wk.healthy(default=True):
+                    kill.append(c.name)
+        return ready, kill

@@ -1,0 +1,42 @@
+"""QoS classification (reference pkg/kubelet/qos/policy.go + util.go).
+
+Guaranteed: every container sets limits and requests == limits for cpu+mem.
+Burstable: at least one container sets a cpu/mem request.
+BestEffort: no requests or limits anywhere — first against the wall under
+memory pressure (eviction ordering, pkg/kubelet/eviction/helpers.go)."""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api import types as api
+
+GUARANTEED = "Guaranteed"
+BURSTABLE = "Burstable"
+BEST_EFFORT = "BestEffort"
+
+_QOS_RESOURCES = (api.RESOURCE_CPU, api.RESOURCE_MEMORY)
+
+
+def qos_class(pod: api.Pod) -> str:
+    requests = limits = False
+    guaranteed = True
+    for c in (pod.spec.containers or []) if pod.spec else []:
+        req = (c.resources.requests if c.resources and c.resources.requests
+               else {})
+        lim = (c.resources.limits if c.resources and c.resources.limits
+               else {})
+        for r in _QOS_RESOURCES:
+            if r in req:
+                requests = True
+            if r in lim:
+                limits = True
+            if req.get(r) != lim.get(r) or r not in lim:
+                guaranteed = False
+    if not requests and not limits:
+        return BEST_EFFORT
+    if guaranteed:
+        return GUARANTEED
+    return BURSTABLE
+
+
+# eviction order under resource pressure: BestEffort evicts first
+EVICTION_ORDER = {BEST_EFFORT: 0, BURSTABLE: 1, GUARANTEED: 2}

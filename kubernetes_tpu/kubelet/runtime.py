@@ -21,10 +21,15 @@ class RunningPod:
     pod: api.Pod
     started_at: str = field(default_factory=now_iso)
     container_ids: List[str] = field(default_factory=list)
+    dead: set = field(default_factory=set)            # container names down
+    restart_counts: Dict[str, int] = field(default_factory=dict)
 
 
 class PodRuntime:
-    """What the kubelet needs from a runtime: run, kill, observe."""
+    """What the kubelet needs from a runtime: run, kill, observe — plus
+    the container-level hooks PLEG and the probers drive. The container
+    hooks have safe defaults so a minimal custom runtime keeps working
+    (no PLEG events, probes observe healthy)."""
 
     def sync_pod(self, pod: api.Pod) -> None:
         raise NotImplementedError
@@ -35,6 +40,20 @@ class PodRuntime:
     def running(self) -> Dict[str, RunningPod]:
         raise NotImplementedError
 
+    # --- container-level (PLEG + probes); override to participate ------------
+
+    def container_states(self, pod_key: str) -> Dict[str, str]:
+        return {}          # no per-container observability -> no PLEG events
+
+    def kill_container(self, pod_key: str, cname: str) -> None:
+        pass
+
+    def restart_container(self, pod_key: str, cname: str) -> None:
+        pass
+
+    def exec_probe(self, pod_key: str, cname: str, command) -> int:
+        return 0           # exec probes observe healthy by default
+
 
 class FakeRuntime(PodRuntime):
     """Instant-start runtime (EnableSleep mimics the fake docker client's
@@ -43,6 +62,7 @@ class FakeRuntime(PodRuntime):
     def __init__(self, start_latency: float = 0.0):
         self._lock = threading.Lock()
         self._pods: Dict[str, RunningPod] = {}
+        self._exec_results: Dict[str, Dict[str, int]] = {}
         self.start_latency = start_latency
         self._counter = 0
 
@@ -63,19 +83,71 @@ class FakeRuntime(PodRuntime):
     def kill_pod(self, pod_key: str) -> None:
         with self._lock:
             self._pods.pop(pod_key, None)
+            self._exec_results.pop(pod_key, None)
 
     def running(self) -> Dict[str, RunningPod]:
         with self._lock:
             return dict(self._pods)
 
+    # --- container-level lifecycle (PLEG + probes drive these) ---------------
+
+    def kill_container(self, pod_key: str, cname: str) -> None:
+        """A container dies (crash / liveness kill); the pod object stays."""
+        with self._lock:
+            rp = self._pods.get(pod_key)
+            if rp is not None:
+                rp.dead.add(cname)
+
+    def restart_container(self, pod_key: str, cname: str) -> None:
+        with self._lock:
+            rp = self._pods.get(pod_key)
+            if rp is None:
+                return
+            rp.dead.discard(cname)
+            rp.restart_counts[cname] = rp.restart_counts.get(cname, 0) + 1
+            self._counter += 1
+            for i, c in enumerate(rp.pod.spec.containers or []):
+                if c.name == cname and i < len(rp.container_ids):
+                    rp.container_ids[i] = f"fake://{self._counter:08x}-{cname}"
+
+    def container_states(self, pod_key: str) -> Dict[str, str]:
+        """name -> "running" | "dead" (the PLEG relist source)."""
+        with self._lock:
+            rp = self._pods.get(pod_key)
+            if rp is None:
+                return {}
+            return {c.name: ("dead" if c.name in rp.dead else "running")
+                    for c in (rp.pod.spec.containers or [])}
+
+    # --- exec probes ----------------------------------------------------------
+
+    def set_exec_result(self, pod_key: str, cname: str, rc: int) -> None:
+        """Test/chaos hook: what `exec` probes observe for this container."""
+        with self._lock:
+            self._exec_results.setdefault(pod_key, {})[cname] = rc
+
+    def exec_probe(self, pod_key: str, cname: str, command) -> int:
+        with self._lock:
+            rp = self._pods.get(pod_key)
+            if rp is None or cname in rp.dead:
+                return 1
+            return self._exec_results.get(pod_key, {}).get(cname, 0)
+
 
 class FakeCadvisor:
-    """Machine info provider (reference pkg/kubelet/cadvisor/testing fake)."""
+    """Machine info provider (reference pkg/kubelet/cadvisor/testing fake).
+    `memory_pressure` is the settable stats signal the eviction manager
+    watches (the hollow analogue of memory.available crossing the hard
+    eviction threshold)."""
 
     def __init__(self, cpu: str = "4", memory: str = "32Gi", pods: str = "110"):
         self.cpu = cpu
         self.memory = memory
         self.pods = pods
+        self.memory_pressure = False
 
     def machine_resources(self) -> Dict[str, str]:
         return {"cpu": self.cpu, "memory": self.memory, "pods": self.pods}
+
+    def under_memory_pressure(self) -> bool:
+        return self.memory_pressure

@@ -1,0 +1,205 @@
+"""Kubelet depth: probes, restart policy via PLEG, QoS memory eviction
+(round-3 verdict #8 — reference pkg/kubelet/{prober,pleg,eviction},
+pkg/probe, pkg/kubelet/qos)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.pleg import CONTAINER_DIED, PLEG
+from kubernetes_tpu.kubelet.qos import (
+    BEST_EFFORT, BURSTABLE, GUARANTEED, qos_class,
+)
+from kubernetes_tpu.kubelet.runtime import FakeCadvisor, FakeRuntime
+
+
+def mk_pod(name, node="n-0", cpu=None, limits=None, liveness=None,
+           readiness=None, restart_policy=""):
+    resources = None
+    if cpu or limits:
+        resources = api.ResourceRequirements(
+            requests={"cpu": cpu} if cpu else None,
+            limits=limits)
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(
+            node_name=node, restart_policy=restart_policy,
+            containers=[api.Container(
+                name="c", image="pause", resources=resources,
+                liveness_probe=liveness, readiness_probe=readiness)]))
+
+
+def exec_probe(period=1, failure_threshold=2, initial_delay=0):
+    return api.Probe(exec=api.ExecAction(command=["check"]),
+                     period_seconds=period,
+                     failure_threshold=failure_threshold,
+                     initial_delay_seconds=initial_delay)
+
+
+class TestQoS:
+    def test_classes(self):
+        assert qos_class(mk_pod("a")) == BEST_EFFORT
+        assert qos_class(mk_pod("b", cpu="100m")) == BURSTABLE
+        g = api.Pod(spec=api.PodSpec(containers=[api.Container(
+            name="c", resources=api.ResourceRequirements(
+                requests={"cpu": "1", "memory": "1Gi"},
+                limits={"cpu": "1", "memory": "1Gi"}))]),
+            metadata=api.ObjectMeta(name="g"))
+        assert qos_class(g) == GUARANTEED
+
+
+class TestPLEG:
+    def test_death_and_restart_events(self):
+        rt = FakeRuntime()
+        pleg = PLEG(rt)
+        p = mk_pod("x")
+        rt.sync_pod(p)
+        assert pleg.relist() == []
+        rt.kill_container("default/x", "c")
+        evs = pleg.relist()
+        assert len(evs) == 1 and evs[0].type == CONTAINER_DIED
+        assert pleg.relist() == []          # no repeat for the same death
+        rt.restart_container("default/x", "c")
+        assert [e.type for e in pleg.relist()] == ["ContainerStarted"]
+
+
+@pytest.fixture()
+def node_env():
+    server = APIServer().start()
+    client = RESTClient.for_server(server, qps=2000, burst=2000)
+    kl = Kubelet(client, "n-0", runtime=FakeRuntime(),
+                 cadvisor=FakeCadvisor(),
+                 heartbeat_period=0.5, sync_period=0.2, eviction_period=0.3)
+    kl.start()
+    yield client, kl
+    kl.stop()
+    server.stop()
+
+
+def wait_for(fn, timeout=20, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"timed out: {msg}")
+
+
+def pod_status(client, name):
+    return client.get("pods", name, "default").status
+
+
+class TestProbesE2E:
+    def test_readiness_gates_ready_condition(self, node_env):
+        client, kl = node_env
+        client.create("pods", mk_pod(
+            "web", readiness=exec_probe(period=1, failure_threshold=1)))
+        # starts Running but NOT ready (no successful probe yet -> then
+        # the first exec success flips it)
+        wait_for(lambda: (pod_status(client, "web") or api.PodStatus()).phase
+                 == api.POD_RUNNING, msg="pod running")
+        wait_for(lambda: any(
+            c.type == api.POD_READY and c.status == api.CONDITION_TRUE
+            for c in (pod_status(client, "web").conditions or [])),
+            msg="ready after probe success")
+        # probe starts failing -> unready without a restart
+        kl.runtime.set_exec_result("default/web", "c", 1)
+        wait_for(lambda: any(
+            c.type == api.POD_READY and c.status == api.CONDITION_FALSE
+            for c in (pod_status(client, "web").conditions or [])),
+            msg="unready after probe failures")
+        sts = pod_status(client, "web").container_statuses or []
+        assert sts and sts[0].restart_count == 0
+
+    def test_liveness_failure_restarts_with_count(self, node_env):
+        client, kl = node_env
+        client.create("pods", mk_pod(
+            "app", liveness=exec_probe(period=0, failure_threshold=2)))
+        wait_for(lambda: (pod_status(client, "app") or api.PodStatus()).phase
+                 == api.POD_RUNNING, msg="pod running")
+        kl.runtime.set_exec_result("default/app", "c", 1)
+
+        def restarted():
+            sts = pod_status(client, "app").container_statuses or []
+            return sts and sts[0].restart_count >= 1
+        wait_for(restarted, msg="liveness kill + restart with count")
+        # the restart cleared the probe's exec override? no — it persists;
+        # make it healthy again and the pod stays Running
+        kl.runtime.set_exec_result("default/app", "c", 0)
+        time.sleep(1.0)
+        assert pod_status(client, "app").phase == api.POD_RUNNING
+
+    def test_restart_policy_never_fails_pod(self, node_env):
+        client, kl = node_env
+        client.create("pods", mk_pod("once", restart_policy="Never"))
+        wait_for(lambda: (pod_status(client, "once") or api.PodStatus()).phase
+                 == api.POD_RUNNING, msg="pod running")
+        kl.runtime.kill_container("default/once", "c")
+        wait_for(lambda: pod_status(client, "once").phase == api.POD_FAILED,
+                 msg="policy Never -> Failed")
+        assert pod_status(client, "once").reason == "ContainersDied"
+
+
+class TestEvictionE2E:
+    def test_memory_pressure_evicts_by_qos_and_flips_condition(self, node_env):
+        client, kl = node_env
+        client.create("pods", mk_pod("burstable", cpu="100m"))
+        client.create("pods", mk_pod("besteffort"))
+        wait_for(lambda: len(kl.runtime.running()) == 2, msg="both running")
+
+        kl.cadvisor.memory_pressure = True
+        # BestEffort is the first victim
+        wait_for(lambda: pod_status(client, "besteffort").reason == "Evicted",
+                 msg="besteffort evicted")
+        assert pod_status(client, "besteffort").phase == api.POD_FAILED
+
+        # node condition flips for the scheduler's
+        # CheckNodeMemoryPressure predicate
+        def pressure_cond():
+            n = client.get("nodes", "n-0")
+            return any(c.type == api.NODE_MEMORY_PRESSURE
+                       and c.status == api.CONDITION_TRUE
+                       for c in (n.status.conditions or []))
+        wait_for(pressure_cond, msg="MemoryPressure=True on node")
+
+        # next interval: the burstable pod goes too
+        wait_for(lambda: pod_status(client, "burstable").reason == "Evicted",
+                 msg="burstable evicted next")
+
+        kl.cadvisor.memory_pressure = False
+        wait_for(lambda: not pressure_cond(), msg="pressure clears")
+
+    def test_scheduler_keeps_besteffort_off_pressured_node(self, node_env):
+        """The other half of the loop: with MemoryPressure=True, the batch
+        scheduler refuses BestEffort pods for that node."""
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+        client, kl = node_env
+        kl.cadvisor.memory_pressure = True
+        wait_for(lambda: any(
+            c.type == api.NODE_MEMORY_PRESSURE and c.status == api.CONDITION_TRUE
+            for c in (client.get("nodes", "n-0").status.conditions or [])),
+            msg="pressure visible")
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(batch_size=8).run()
+        try:
+            client.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name="be", namespace="default"),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="pause")])))
+            # unschedulable: the only node is under memory pressure
+            def unschedulable():
+                p = client.get("pods", "be", "default")
+                conds = (p.status.conditions or []) if p.status else []
+                return any(c.type == api.POD_SCHEDULED
+                           and c.status == api.CONDITION_FALSE
+                           for c in conds) and not p.spec.node_name
+            wait_for(unschedulable, msg="BestEffort refused under pressure")
+        finally:
+            sched.stop()
+            factory.stop()
