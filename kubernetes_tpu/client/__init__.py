@@ -12,3 +12,4 @@ from kubernetes_tpu.client.rest import ApiError, RESTClient
 from kubernetes_tpu.client.cache import FIFO, DeltaFIFO, ThreadSafeStore, meta_namespace_key
 from kubernetes_tpu.client.reflector import ListWatch, Reflector
 from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.client.chaos import install_chaos

@@ -1,0 +1,214 @@
+"""Fault-injecting client transport for chaos testing.
+
+Parity target: reference pkg/client/chaosclient/chaosclient.go — a transport
+wrapper that probabilistically intervenes in requests before they reach the
+wire, so any component can be run against a misbehaving control plane without
+touching the server. Interventions are seeded and deterministic, scoped by
+path, and reported to a notifier so tests can assert on what was injected.
+
+Idiomatic difference from the reference: Go wraps http.RoundTripper; here the
+seam is RESTClient._request_once / RESTClient.watch, installed per-client by
+`install_chaos` and removable with `ChaosController.uninstall()` so a test
+can "heal" the network mid-run.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.client.rest import ApiError, RESTClient
+
+
+class ChaosConnectionReset(ConnectionResetError):
+    """Simulated transport failure (chaosclient's simulated connection
+    reset). Distinct type so tests can tell injected faults from real ones."""
+
+    def __init__(self):
+        super().__init__("connection reset by peer (chaos)")
+
+
+class Intervention:
+    """What a chaos link decided to do instead of the real request: raise
+    `error`, or short-circuit with HTTP `status` (a Status-shaped dict)."""
+
+    __slots__ = ("source", "error", "status")
+
+    def __init__(self, source: str, error: Optional[Exception] = None,
+                 status: Optional[dict] = None):
+        self.source = source
+        self.error = error
+        self.status = status
+
+    def apply(self):
+        if self.error is not None:
+            raise self.error
+        return self.status
+
+
+class NetworkError:
+    """Fail the request with a simulated connection reset."""
+
+    def intervene(self, rng, method: str, path: str) -> Optional[Intervention]:
+        return Intervention("NetworkError", error=ChaosConnectionReset())
+
+
+class HTTPError:
+    """Short-circuit with an HTTP error status (e.g. a flaky 500/503)."""
+
+    def __init__(self, code: int = 500, reason: str = "InternalError",
+                 message: str = "chaos"):
+        self.code = code
+        self.reason = reason
+        self.message = message
+
+    def intervene(self, rng, method: str, path: str) -> Optional[Intervention]:
+        return Intervention(
+            f"HTTPError({self.code})",
+            error=ApiError(self.code, self.reason, self.message))
+
+    # watch-open interventions surface the same way (ApiError), request-path
+    # interventions too: RESTClient raises ApiError for >=400 statuses, so
+    # raising it directly is indistinguishable from a server-sent error.
+
+
+class Latency:
+    """Delay the request, then let it through."""
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def intervene(self, rng, method: str, path: str) -> Optional[Intervention]:
+        time.sleep(self.seconds)
+        return None
+
+
+class Probability:
+    """Gate an inner chaos on a seeded coin flip (chaosclient's P)."""
+
+    def __init__(self, p: float, inner):
+        self.p = p
+        self.inner = inner
+
+    def intervene(self, rng, method: str, path: str) -> Optional[Intervention]:
+        if rng.random() < self.p:
+            return self.inner.intervene(rng, method, path)
+        return None
+
+
+class PathChaos:
+    """Scope an inner chaos to request paths matching a regex — e.g. fail
+    only the scheduler's POST /bindings while everything else works."""
+
+    def __init__(self, pattern: str, inner, methods: Optional[set] = None):
+        self.pattern = re.compile(pattern)
+        self.inner = inner
+        self.methods = methods
+
+    def intervene(self, rng, method: str, path: str) -> Optional[Intervention]:
+        if self.methods is not None and method not in self.methods:
+            return None
+        if not self.pattern.search(path):
+            return None
+        return self.inner.intervene(rng, method, path)
+
+
+class _LockedRandom:
+    """Serialized rng draws so concurrent requests can't corrupt the seeded
+    Mersenne state (instance methods of random.Random are not thread-safe)."""
+
+    def __init__(self, rng, lock):
+        self._rng = rng
+        self._lock = lock
+
+    def random(self) -> float:
+        with self._lock:
+            return self._rng.random()
+
+
+class ChaosController:
+    """The installed chain. Tracks interventions; uninstall() heals the
+    client (restores the original transport methods)."""
+
+    def __init__(self, client: RESTClient, links, seed: int,
+                 notifier: Optional[Callable] = None):
+        import random
+        self.client = client
+        self.links = list(links)
+        self._lock = threading.Lock()
+        self._rng = _LockedRandom(random.Random(seed), self._lock)
+        self.notifier = notifier
+        self.interventions: List[tuple] = []  # (source, method, path)
+        self._orig_request_once = client._request_once
+        self._orig_watch = client.watch
+        self._installed = True
+
+    # --- the seam ------------------------------------------------------------
+
+    def _consult(self, method: str, path: str) -> Optional[Intervention]:
+        # links run OUTSIDE the lock: a Latency link's sleep must only delay
+        # the request it intervened on, never other threads' requests; only
+        # the rng draw and the interventions log are serialized
+        for link in self.links:
+            iv = link.intervene(self._rng, method, path)
+            if iv is not None:
+                with self._lock:
+                    self.interventions.append((iv.source, method, path))
+                return iv
+        return None
+
+    def _request_once(self, method: str, path: str, body=None) -> dict:
+        iv = self._consult(method, path)
+        if iv is not None:
+            if self.notifier:
+                self.notifier(iv, method, path)
+            out = iv.apply()
+            if out is not None:
+                # honor the real seam's contract (rest.py): >=400 raises
+                # ApiError, except 429 which is returned for request()'s
+                # retry loop — a raw error Status must never decode into a
+                # phantom resource object
+                code = out.get("code", 0)
+                if code >= 400 and code != 429:
+                    raise ApiError(code, out.get("reason", "Unknown"),
+                                   out.get("message", ""))
+                return out
+        return self._orig_request_once(method, path, body)
+
+    def _watch(self, resource: str, namespace: str = "", **kw):
+        # watches open a dedicated connection; chaos at open time models a
+        # watch that can't (re)connect, driving the Reflector's re-list path
+        path = f"watch:{resource}"
+        iv = self._consult("WATCH", path)
+        if iv is not None:
+            if self.notifier:
+                self.notifier(iv, "WATCH", path)
+            iv.apply()
+        return self._orig_watch(resource, namespace, **kw)
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def uninstall(self):
+        """Heal: restore the unwrapped transport."""
+        if self._installed:
+            self.client._request_once = self._orig_request_once
+            self.client.watch = self._orig_watch
+            self._installed = False
+
+    def count(self, source_prefix: str = "") -> int:
+        with self._lock:
+            return sum(1 for s, _, _ in self.interventions
+                       if s.startswith(source_prefix))
+
+
+def install_chaos(client: RESTClient, *links, seed: int = 0,
+                  notifier: Optional[Callable] = None) -> ChaosController:
+    """Wrap `client`'s transport with a chaos chain. Links are consulted in
+    order per request; the first intervention wins. Returns the controller
+    (use .uninstall() to heal)."""
+    ctl = ChaosController(client, links, seed, notifier)
+    client._request_once = ctl._request_once
+    client.watch = ctl._watch
+    return ctl

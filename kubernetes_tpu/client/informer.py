@@ -25,12 +25,14 @@ log = logging.getLogger("informer")
 
 class Informer:
     def __init__(self, lw: ListWatch, key_func: Callable = meta_namespace_key,
-                 indexers: Optional[Dict[str, Callable]] = None):
+                 indexers: Optional[Dict[str, Callable]] = None,
+                 relist_backoff: float = 1.0):
         self.store = ThreadSafeStore(indexers)
         self.key = key_func
         self._handlers: List[dict] = []
         self._events: "queue.Queue" = queue.Queue()
-        self.reflector = Reflector(lw, self._Sink(self))
+        self.reflector = Reflector(lw, self._Sink(self),
+                                   relist_backoff=relist_backoff)
         self._dispatch_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 

@@ -54,6 +54,15 @@ class Kubelet:
         self._ip_counter = 0
         self._statuses: Dict[str, tuple] = {}  # key -> last written signature
         self._ready: Dict[str, bool] = {}      # key -> last probed readiness
+        # pods WE declared terminal (evicted / died with restartPolicy=Never /
+        # failed admission): a stale watch event still carrying phase=Running
+        # must never restart them (the reference's status manager owns the
+        # same authority over locally-terminated pods)
+        self._terminal: set = set()
+        # terminal writes that failed transiently; retried each resync tick
+        # (a stuck phase=Running in the API strands node capacity forever)
+        self._pending_terminal: Dict[str, tuple] = {}
+        self._heartbeat_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads = []
         self.probes = ProbeManager(self.runtime)
@@ -93,7 +102,14 @@ class Kubelet:
 
     def heartbeat(self):
         """Refresh the Ready + MemoryPressure conditions (node status
-        update loop; MemoryPressure fed by the eviction manager)."""
+        update loop; MemoryPressure fed by the eviction manager). Serialized:
+        the eviction tick's prompt heartbeat must not lose its fresh
+        MemoryPressure flip to the periodic thread's concurrent
+        read-modify-write."""
+        with self._heartbeat_lock:
+            self._heartbeat_locked()
+
+    def _heartbeat_locked(self):
         try:
             node = self.client.get("nodes", self.node_name)
         except ApiError:
@@ -129,6 +145,10 @@ class Kubelet:
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
         if pod.metadata.deletion_timestamp is not None:
             self.runtime.kill_pod(key)
+            return
+        if key in self._terminal:
+            # we already declared this pod Failed (eviction / Never-policy
+            # death / admission): ignore stale Running snapshots
             return
         phase = pod.status.phase if pod.status else ""
         if phase in (api.POD_SUCCEEDED, api.POD_FAILED):
@@ -174,6 +194,10 @@ class Kubelet:
         restarts = tuple(sorted(running.restart_counts.items())) \
             if running else ()
         sig = (phase, reason, ready, restarts)
+        if phase in (api.POD_FAILED, api.POD_SUCCEEDED):
+            # local decision is authoritative even if the write below fails;
+            # _sync_pod consults this before ever (re)starting the pod
+            self._terminal.add(key)
         if self._statuses.get(key) == sig:
             return
         fresh = deep_copy(pod)
@@ -212,9 +236,16 @@ class Kubelet:
         try:
             self.client.update_status("pods", fresh)
             self._statuses[key] = sig
+            self._pending_terminal.pop(key, None)
         except ApiError as e:
-            if not e.is_not_found:
-                log.warning("status update for %s failed: %s", key, e)
+            if e.is_not_found:
+                self._pending_terminal.pop(key, None)
+                return
+            log.warning("status update for %s failed: %s", key, e)
+            if phase in (api.POD_FAILED, api.POD_SUCCEEDED):
+                # _sync_pod short-circuits terminal pods, so nothing else
+                # would ever retry this write — queue it for the resync tick
+                self._pending_terminal[key] = (pod, phase, reason, message)
 
     def _pod_deleted(self, pod: api.Pod):
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
@@ -222,6 +253,7 @@ class Kubelet:
         self.probes.forget_pod(key)
         self._statuses.pop(key, None)
         self._ready.pop(key, None)
+        self._terminal.discard(key)  # a recreated name starts fresh
 
     def _resync(self):
         """Desired-state reconcile (kill runtime pods no longer desired)
@@ -234,6 +266,10 @@ class Kubelet:
             if key not in desired:
                 self.runtime.kill_pod(key)
                 self.probes.forget_pod(key)
+
+        # retry terminal status writes that failed transiently
+        for key, args in list(self._pending_terminal.items()):
+            self._set_status(*args)
 
         # PLEG: container deaths -> restart policy (pleg/generic.go:180)
         for ev in self.pleg.relist():
@@ -252,6 +288,9 @@ class Kubelet:
                 # the probe loop below writes the status (restart_counts
                 # changed its signature) with probe-derived readiness
             else:  # Never: terminated containers end the pod
+                # terminal BEFORE kill: the informer dispatch thread must
+                # never observe killed-but-not-yet-terminal and resurrect
+                self._terminal.add(ev.pod_key)
                 self.runtime.kill_pod(ev.pod_key)
                 self.probes.forget_pod(ev.pod_key)
                 self._set_status(pod, api.POD_FAILED,
@@ -287,6 +326,7 @@ class Kubelet:
         if rp is None:
             return
         pod = rp.pod
+        self._terminal.add(victim)  # before the kill — see _resync Never path
         self.recorder.event(pod, "Warning", EVICTED_REASON,
                             "The node was low on resource: memory.")
         self.runtime.kill_pod(victim)

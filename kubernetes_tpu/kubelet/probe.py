@@ -26,6 +26,14 @@ from typing import Dict, Optional, Tuple
 from kubernetes_tpu.api import types as api
 
 
+def _timeout(probe: api.Probe) -> float:
+    # 0 would put the socket in non-blocking mode (instant BlockingIOError,
+    # permanent probe failure); the reference validates timeoutSeconds >= 1,
+    # so floor at 1 rather than honor a meaningless 0
+    t = probe.timeout_seconds
+    return 1 if t is None or t <= 0 else t
+
+
 def run_probe(probe: api.Probe, pod: api.Pod, container: api.Container,
               runtime) -> bool:
     """One probe attempt -> healthy?"""
@@ -33,27 +41,39 @@ def run_probe(probe: api.Probe, pod: api.Pod, container: api.Container,
     if probe.exec and probe.exec.command is not None:
         return runtime.exec_probe(key, container.name,
                                   probe.exec.command) == 0
+    # Network probes: a hollow runtime fabricates pod IPs, so real connects
+    # would block their full timeout against unroutable addresses and stall
+    # the shared sync tick. Such runtimes advertise fakes_network and answer
+    # from the same health table as exec probes; real I/O only happens when
+    # the probe names an explicit host (httpGet.host).
     if probe.http_get is not None:
         g = probe.http_get
+        if not g.host and getattr(runtime, "fakes_network", False):
+            return runtime.network_probe(key, container.name)
         host = g.host or (pod.status.pod_ip if pod.status else "") \
             or "127.0.0.1"
         try:
             conn = http.client.HTTPConnection(
-                host, int(g.port or 80), timeout=probe.timeout_seconds or 1)
+                host, int(g.port or 80), timeout=_timeout(probe))
             conn.request("GET", g.path or "/")
             code = conn.getresponse().status
             conn.close()
             return 200 <= code < 400
-        except OSError:
+        except (OSError, http.client.HTTPException, ValueError):
+            # HTTPException: non-HTTP bytes on the port (BadStatusLine);
+            # ValueError: unresolvable named port — all mean "unhealthy",
+            # never "abort the kubelet's whole sync tick"
             return False
     if probe.tcp_socket is not None:
+        if getattr(runtime, "fakes_network", False):
+            return runtime.network_probe(key, container.name)
         host = (pod.status.pod_ip if pod.status else "") or "127.0.0.1"
         try:
             with socket.create_connection(
                     (host, int(probe.tcp_socket.port or 0)),
-                    timeout=probe.timeout_seconds or 1):
+                    timeout=_timeout(probe)):
                 return True
-        except OSError:
+        except (OSError, ValueError):
             return False
     return True  # no handler = always healthy (reference: nil probe)
 
@@ -87,7 +107,9 @@ class ProbeManager:
         wk = self._workers.get((key, cname, kind))
         if wk is None:
             wk = _Worker(probe=probe, kind=kind, started=self._clock())
-            wk.next_due = wk.started + (probe.initial_delay_seconds or 0)
+            delay = (0 if probe.initial_delay_seconds is None
+                     else probe.initial_delay_seconds)
+            wk.next_due = wk.started + delay
             self._workers[(key, cname, kind)] = wk
         return wk
 
@@ -117,16 +139,22 @@ class ProbeManager:
                 wk = self._worker(key, c.name, kind, probe)
                 if now >= wk.next_due:
                     ok = run_probe(probe, pod, c, self.runtime)
-                    wk.next_due = now + (probe.period_seconds or 10)
+                    # explicit 0s are honored (period 0 = probe every step);
+                    # the api.Probe dataclass already supplies the reference
+                    # defaults for absent fields
+                    wk.next_due = now + (10 if probe.period_seconds is None
+                                         else probe.period_seconds)
                     if ok:
                         wk.successes += 1
                         wk.failures = 0
-                        if wk.successes >= (probe.success_threshold or 1):
+                        if wk.successes >= (1 if probe.success_threshold is None
+                                            else probe.success_threshold):
                             wk.result = True
                     else:
                         wk.failures += 1
                         wk.successes = 0
-                        if wk.failures >= (probe.failure_threshold or 3):
+                        if wk.failures >= (3 if probe.failure_threshold is None
+                                           else probe.failure_threshold):
                             wk.result = False
                 if kind == "readiness":
                     # unready until the first success (prober/worker.go)

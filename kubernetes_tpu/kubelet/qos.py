@@ -1,13 +1,19 @@
 """QoS classification (reference pkg/kubelet/qos/policy.go + util.go).
 
 Guaranteed: every container sets limits and requests == limits for cpu+mem.
-Burstable: at least one container sets a cpu/mem request.
+Burstable: some resource is requested/limited but not Guaranteed-shaped.
 BestEffort: no requests or limits anywhere — first against the wall under
-memory pressure (eviction ordering, pkg/kubelet/eviction/helpers.go)."""
+memory pressure (eviction ordering, pkg/kubelet/eviction/helpers.go).
+
+BestEffort is the scheduler's predicates.is_best_effort — ONE definition
+shared by the eviction ranking here and CheckNodeMemoryPressure there, so an
+extended-resource-only pod (e.g. TPU, no cpu/mem) can never be evicted as
+BestEffort yet rescheduled onto the pressured node as non-BestEffort."""
 
 from __future__ import annotations
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.predicates import is_best_effort
 
 GUARANTEED = "Guaranteed"
 BURSTABLE = "Burstable"
@@ -17,7 +23,8 @@ _QOS_RESOURCES = (api.RESOURCE_CPU, api.RESOURCE_MEMORY)
 
 
 def qos_class(pod: api.Pod) -> str:
-    requests = limits = False
+    if is_best_effort(pod):
+        return BEST_EFFORT
     guaranteed = True
     for c in (pod.spec.containers or []) if pod.spec else []:
         req = (c.resources.requests if c.resources and c.resources.requests
@@ -25,14 +32,8 @@ def qos_class(pod: api.Pod) -> str:
         lim = (c.resources.limits if c.resources and c.resources.limits
                else {})
         for r in _QOS_RESOURCES:
-            if r in req:
-                requests = True
-            if r in lim:
-                limits = True
             if req.get(r) != lim.get(r) or r not in lim:
                 guaranteed = False
-    if not requests and not limits:
-        return BEST_EFFORT
     if guaranteed:
         return GUARANTEED
     return BURSTABLE

@@ -54,6 +54,14 @@ class PodRuntime:
     def exec_probe(self, pod_key: str, cname: str, command) -> int:
         return 0           # exec probes observe healthy by default
 
+    # A runtime whose pod IPs are fabricated (hollow nodes) sets this so
+    # httpGet/tcpSocket probes are answered from network_probe instead of
+    # blocking real connects against unroutable addresses.
+    fakes_network = False
+
+    def network_probe(self, pod_key: str, cname: str) -> bool:
+        return True
+
 
 class FakeRuntime(PodRuntime):
     """Instant-start runtime (EnableSleep mimics the fake docker client's
@@ -132,6 +140,12 @@ class FakeRuntime(PodRuntime):
             if rp is None or cname in rp.dead:
                 return 1
             return self._exec_results.get(pod_key, {}).get(cname, 0)
+
+    # hollow network: http/tcp probes observe the same health table
+    fakes_network = True
+
+    def network_probe(self, pod_key: str, cname: str) -> bool:
+        return self.exec_probe(pod_key, cname, None) == 0
 
 
 class FakeCadvisor:

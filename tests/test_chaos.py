@@ -1,0 +1,281 @@
+"""Fault injection: the chaos client and crash-recovery chaos tests.
+
+Parity targets:
+- pkg/client/chaosclient/chaosclient.go — probabilistic transport faults
+- plugin/pkg/scheduler/schedulercache/cache.go:278-308 — assumed-pod TTL
+  self-repair: a scheduler that dies (or loses its binds) between AssumePod
+  and a landed binding must not lose pods or double-bind them; the system
+  recovers by timeout + re-list, not rollback (SURVEY §5).
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.chaos import (
+    ChaosConnectionReset, HTTPError, Latency, NetworkError, PathChaos,
+    Probability, install_chaos,
+)
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.scheduler.factory import ConfigFactory, Scheduler
+
+from tests.test_scheduler_e2e import mk_node, mk_pod, wait_scheduled
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=5000, burst=5000)
+
+
+class TestChaosChain:
+    def test_network_error_raises_simulated_reset(self, server):
+        c = RESTClient.for_server(server)
+        install_chaos(c, NetworkError())
+        with pytest.raises(ChaosConnectionReset):
+            c.list("pods", "default")
+
+    def test_http_error_surfaces_as_api_error(self, server):
+        c = RESTClient.for_server(server)
+        install_chaos(c, HTTPError(503, "ServiceUnavailable"))
+        with pytest.raises(ApiError) as ei:
+            c.list("pods", "default")
+        assert ei.value.code == 503
+
+    def test_probability_is_seeded_and_deterministic(self, server):
+        def run(seed):
+            c = RESTClient.for_server(server)
+            ctl = install_chaos(c, Probability(0.5, NetworkError()), seed=seed)
+            outcomes = []
+            for _ in range(40):
+                try:
+                    c.list("pods", "default")
+                    outcomes.append(True)
+                except ChaosConnectionReset:
+                    outcomes.append(False)
+            return outcomes, ctl.count()
+
+        a, na = run(7)
+        b, nb = run(7)
+        other, _ = run(8)
+        assert a == b and na == nb
+        assert a != other  # different seed, different fault pattern
+        assert 0 < na < 40  # actually probabilistic
+
+    def test_path_scoping_only_hits_matching_requests(self, server):
+        c = RESTClient.for_server(server)
+        ctl = install_chaos(
+            c, PathChaos(r"/bindings$", NetworkError(), methods={"POST"}))
+        c.list("pods", "default")  # unaffected
+        c.create("nodes", mk_node("n1"))  # unaffected
+        binding = api.Binding(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n1"))
+        with pytest.raises(ChaosConnectionReset):
+            c.bind(binding, "default")
+        assert ctl.count("NetworkError") == 1
+        assert [(m, p) for _, m, p in ctl.interventions] == [
+            ("POST", "/api/v1/namespaces/default/bindings")]
+
+    def test_uninstall_heals(self, server):
+        c = RESTClient.for_server(server)
+        ctl = install_chaos(c, NetworkError())
+        with pytest.raises(ChaosConnectionReset):
+            c.list("pods", "default")
+        ctl.uninstall()
+        c.list("pods", "default")  # healed
+
+    def test_latency_passes_through(self, server):
+        c = RESTClient.for_server(server)
+        install_chaos(c, Latency(0.05))
+        t0 = time.monotonic()
+        c.list("pods", "default")
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_notifier_sees_interventions(self, server):
+        c = RESTClient.for_server(server)
+        seen = []
+        install_chaos(c, HTTPError(500),
+                      notifier=lambda iv, m, p: seen.append((iv.source, m)))
+        with pytest.raises(ApiError):
+            c.get("pods", "x", "default")
+        assert seen == [("HTTPError(500)", "GET")]
+
+
+class TestReflectorUnderChaos:
+    def test_informer_syncs_through_flaky_transport(self, server, client):
+        """A 30%-lossy client (lists AND watch opens fail) must still
+        converge: the Reflector's retry/re-list loop is the recovery path."""
+        for i in range(5):
+            client.create("nodes", mk_node(f"n{i}"))
+        flaky = RESTClient.for_server(server)
+        install_chaos(flaky, Probability(0.3, NetworkError()), seed=3)
+        inf = Informer(ListWatch(flaky, "nodes"), relist_backoff=0.05)
+        inf.run()
+        try:
+            assert inf.wait_for_sync(10)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(inf.store.list_keys()) == 5:
+                    break
+                time.sleep(0.05)
+            assert len(inf.store.list_keys()) == 5
+            # and incremental events keep flowing post-sync
+            client.create("nodes", mk_node("late"))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if inf.store.get("late") is not None:
+                    return
+                time.sleep(0.05)
+            raise AssertionError("late node never arrived through chaos")
+        finally:
+            inf.stop()
+
+
+class _BindDroppingScheduler(Scheduler):
+    """A scheduler whose process 'dies' between AssumePod and Bind: decisions
+    are made and assumed, but the binding never leaves the box. Captures the
+    decisions so the test can replay them later as a zombie binder."""
+
+    def __init__(self, factory, algorithm):
+        super().__init__(factory, algorithm)
+        self.dropped = []
+        self._dropped_lock = threading.Lock()
+
+    def _spawn_bind(self, pod, dest, t_start, did_assume):
+        with self._dropped_lock:
+            self.dropped.append((pod, dest))
+
+
+class TestSchedulerCrashMidBatch:
+    def _fill(self, client, n_nodes=4, n_pods=12):
+        for i in range(n_nodes):
+            client.create("nodes", mk_node(f"n{i}", cpu="2", pods="5"))
+        for i in range(n_pods):
+            client.create("pods", mk_pod(f"p{i:02d}", cpu="500m"))
+
+    def _wait_drained(self, factory, sched, n, timeout=15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(sched.dropped) >= n:
+                return
+            time.sleep(0.05)
+        raise AssertionError(
+            f"scheduler only decided {len(sched.dropped)}/{n} pods")
+
+    def test_successor_reschedules_everything(self, server, client):
+        """Scheduler A assumes 12 pods then dies before any bind lands. A
+        fresh scheduler B re-lists: every pod must end up bound exactly once
+        with node capacity respected — nothing is lost with the assumes."""
+        self._fill(client)
+        fa = ConfigFactory(RESTClient.for_server(server, qps=1000, burst=1000))
+        fa.run()
+        a = _BindDroppingScheduler(
+            fa, fa.create_from_provider().algorithm).run()
+        self._wait_drained(fa, a, 12)
+        a.stop()
+        fa.stop()  # process death: cache, assumes, FIFO all gone
+
+        # nothing was ever bound
+        pods, _ = client.list("pods", "default")
+        assert all(not p.spec.node_name for p in pods)
+
+        fb = ConfigFactory(RESTClient.for_server(server, qps=1000, burst=1000))
+        fb.run()
+        b = fb.create_from_provider().run()
+        try:
+            done = wait_scheduled(client, 12, timeout=30)
+            by_node = {}
+            for p in done:
+                by_node[p.spec.node_name] = by_node.get(p.spec.node_name, 0) + 1
+            # 2 CPU/node, 500m/pod -> max 4 per node; pods cap 5
+            assert sum(by_node.values()) == 12
+            for node, cnt in by_node.items():
+                assert cnt <= 4, f"{node} overcommitted after recovery"
+        finally:
+            b.stop()
+            fb.stop()
+
+    def test_zombie_binds_rejected_by_cas(self, server, client):
+        """Scheduler A's binds arrive LATE — after successor B already bound
+        the pods elsewhere. The BindingREST CAS (nodeName iff empty) must
+        reject every conflicting zombie bind and keep B's assignments."""
+        self._fill(client, n_nodes=3, n_pods=6)
+        fa = ConfigFactory(RESTClient.for_server(server, qps=1000, burst=1000))
+        fa.run()
+        a = _BindDroppingScheduler(
+            fa, fa.create_from_provider().algorithm).run()
+        self._wait_drained(fa, a, 6)
+        a.stop()
+        fa.stop()
+        zombie_decisions = list(a.dropped)
+
+        fb = ConfigFactory(RESTClient.for_server(server, qps=1000, burst=1000))
+        fb.run()
+        b = fb.create_from_provider().run()
+        try:
+            done = wait_scheduled(client, 6, timeout=30)
+            want = {p.metadata.name: p.spec.node_name for p in done}
+        finally:
+            b.stop()
+            fb.stop()
+
+        conflicts = 0
+        for pod, dest in zombie_decisions:
+            binding = api.Binding(
+                metadata=api.ObjectMeta(name=pod.metadata.name,
+                                        namespace="default"),
+                target=api.ObjectReference(kind="Node", name=dest))
+            try:
+                client.bind(binding, "default")
+            except ApiError as e:
+                assert e.is_conflict
+                conflicts += 1
+        pods, _ = client.list("pods", "default")
+        got = {p.metadata.name: p.spec.node_name for p in pods}
+        assert got == want, "zombie binds moved pods"
+        # every zombie bind either matched B's choice (idempotent no-op) or
+        # conflicted; none may have re-assigned
+        assert conflicts == sum(
+            1 for pod, dest in zombie_decisions
+            if want[pod.metadata.name] != dest)
+
+    def test_bind_outage_heals_and_pods_land(self, server, client):
+        """All POST /bindings fail (path-scoped chaos) while the scheduler
+        runs: assumes must be rolled back on bind failure and pods requeued
+        with backoff; once the outage heals, every pod lands."""
+        for i in range(2):
+            client.create("nodes", mk_node(f"n{i}"))
+        sched_client = RESTClient.for_server(server, qps=1000, burst=1000)
+        ctl = install_chaos(
+            sched_client,
+            PathChaos(r"/bindings$", NetworkError(), methods={"POST"}))
+        f = ConfigFactory(sched_client)
+        f.run()
+        s = f.create_from_provider().run()
+        try:
+            for i in range(4):
+                client.create("pods", mk_pod(f"p{i}"))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and ctl.count("NetworkError") < 4:
+                time.sleep(0.05)
+            assert ctl.count("NetworkError") >= 4, "no binds were attempted"
+            # during the outage nothing is bound
+            pods, _ = client.list("pods", "default")
+            assert all(not p.spec.node_name for p in pods)
+            ctl.uninstall()  # heal
+            done = wait_scheduled(client, 4, timeout=45)  # backoff retry ~1-2s
+            assert len({p.metadata.name for p in done}) == 4
+        finally:
+            s.stop()
+            f.stop()

@@ -51,6 +51,19 @@ class TestQoS:
             metadata=api.ObjectMeta(name="g"))
         assert qos_class(g) == GUARANTEED
 
+    def test_extended_resource_only_pod_agrees_with_scheduler(self):
+        """A TPU/GPU-only pod must classify identically for eviction ranking
+        (here) and CheckNodeMemoryPressure (scheduler) — divergence caused an
+        evict/reschedule loop."""
+        from kubernetes_tpu.scheduler.predicates import is_best_effort
+        p = api.Pod(
+            metadata=api.ObjectMeta(name="tpu", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", resources=api.ResourceRequirements(
+                    requests={api.RESOURCE_GPU: "1"}))]))
+        assert not is_best_effort(p)
+        assert qos_class(p) != BEST_EFFORT
+
 
 class TestPLEG:
     def test_death_and_restart_events(self):
@@ -173,6 +186,29 @@ class TestEvictionE2E:
 
         kl.cadvisor.memory_pressure = False
         wait_for(lambda: not pressure_cond(), msg="pressure clears")
+
+    def test_stale_running_event_cannot_resurrect_evicted_pod(self, node_env):
+        """An informer event still carrying phase=Running (snapshotted before
+        the eviction) must not re-admit the pod: the kubelet's own terminal
+        record is authoritative."""
+        client, kl = node_env
+        client.create("pods", mk_pod("victim"))
+        wait_for(lambda: "default/victim" in kl.runtime.running(),
+                 msg="running")
+        # snapshot the pod as the informer would have seen it pre-eviction
+        stale = client.get("pods", "victim", "default")
+        stale.status = stale.status or api.PodStatus()
+        stale.status.phase = api.POD_RUNNING
+
+        kl.cadvisor.memory_pressure = True
+        wait_for(lambda: pod_status(client, "victim").reason == "Evicted",
+                 msg="evicted")
+        assert "default/victim" not in kl.runtime.running()
+
+        kl._sync_pod(stale)  # the stale event arrives late
+        time.sleep(0.5)
+        assert "default/victim" not in kl.runtime.running(), "resurrected!"
+        assert pod_status(client, "victim").phase == api.POD_FAILED
 
     def test_scheduler_keeps_besteffort_off_pressured_node(self, node_env):
         """The other half of the loop: with MemoryPressure=True, the batch
