@@ -1,0 +1,108 @@
+"""Conversion + defaulting: multiple wire versions over one internal form.
+
+Parity target: reference pkg/conversion/converter.go and the Scheme's
+versioning machinery (pkg/runtime/scheme.go:43): storage and every component
+operate on INTERNAL types; each wire version decodes into its own dataclasses
+which convert to/from internal at the API boundary, and versioned decode
+applies registered defaulting functions (Scheme.Default) before conversion.
+
+Idiomatic difference: instead of Go's reflection-with-generated-fast-paths,
+the default path walks dataclass fields by name (same-named fields copy;
+nested dataclasses recurse when the declared destination type differs), and
+registered per-(src, dst) functions override it for renamed/restructured
+fields — the analogue of Converter.RegisterConversionFunc.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Callable, Dict, Tuple, Type
+
+from kubernetes_tpu.api.serialization import _hints, _strip_optional
+
+
+class ConversionError(Exception):
+    pass
+
+
+class Converter:
+    """(src class, dst class) -> conversion, with a reflective default."""
+
+    def __init__(self):
+        self._funcs: Dict[Tuple[Type, Type], Callable] = {}
+
+    def register(self, src: Type, dst: Type, fn: Callable) -> None:
+        """fn(src_obj, convert) -> dst_obj, where convert(child, DstCls)
+        recursively converts nested values."""
+        self._funcs[(src, dst)] = fn
+
+    def register_pair(self, a: Type, b: Type, a_to_b: Callable,
+                      b_to_a: Callable) -> None:
+        self.register(a, b, a_to_b)
+        self.register(b, a, b_to_a)
+
+    def convert(self, obj, dst: Type):
+        if obj is None:
+            return None
+        src = type(obj)
+        if src is dst:
+            return obj
+        fn = self._funcs.get((src, dst))
+        if fn is not None:
+            return fn(obj, self.convert)
+        if dataclasses.is_dataclass(src) and dataclasses.is_dataclass(dst):
+            return self._convert_default(obj, dst)
+        raise ConversionError(f"no conversion from {src.__name__} "
+                              f"to {dst.__name__}")
+
+    def _convert_default(self, obj, dst: Type):
+        """Field-by-field copy for same-named fields; nested dataclass
+        values recurse into the destination's declared field type (the
+        reference's DefaultConvert)."""
+        hints = _hints(dst)
+        kwargs = {}
+        for f in dataclasses.fields(dst):
+            if not hasattr(obj, f.name):
+                continue
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            kwargs[f.name] = self._convert_value(v, _strip_optional(hints[f.name]))
+        return dst(**kwargs)
+
+    def _convert_value(self, v, want: Type):
+        origin = typing.get_origin(want)
+        if origin in (list, tuple):
+            (elem,) = typing.get_args(want) or (typing.Any,)
+            elem = _strip_optional(elem)
+            out = [self._convert_value(x, elem) for x in v]
+            return tuple(out) if origin is tuple else out
+        if origin is dict:
+            args = typing.get_args(want)
+            velem = _strip_optional(args[1]) if len(args) == 2 else typing.Any
+            return {k: self._convert_value(x, velem) for k, x in v.items()}
+        if dataclasses.is_dataclass(want) and isinstance(v, type) is False \
+                and dataclasses.is_dataclass(type(v)) and type(v) is not want:
+            return self.convert(v, want)
+        return v
+
+
+class Defaulter:
+    """Per-class defaulting functions applied to freshly-decoded versioned
+    objects (Scheme.Default). Functions mutate in place."""
+
+    def __init__(self):
+        self._funcs: Dict[Type, Callable] = {}
+
+    def register(self, cls: Type, fn: Callable) -> None:
+        self._funcs[cls] = fn
+
+    def default(self, obj) -> None:
+        fn = self._funcs.get(type(obj))
+        if fn is not None:
+            fn(obj)
+
+
+converter = Converter()   # the process-wide converter (versions register in)
+defaulter = Defaulter()

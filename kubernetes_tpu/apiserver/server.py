@@ -48,12 +48,32 @@ from kubernetes_tpu.storage import store as store_mod
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 _PATH = re.compile(
-    r"^(?:/api/v1|/apis/(?P<group>[a-z0-9.-]+)/(?P<gversion>v[a-z0-9]+))"
+    r"^(?:/api/(?P<cver>v[0-9][a-z0-9]*)"
+    r"|/apis/(?P<group>[a-z0-9.-]+)/(?P<gversion>v[a-z0-9]+))"
     r"(?:/namespaces/(?P<ns>[a-z0-9-]+))?"
     r"/(?P<resource>[a-z]+)"
     r"(?:/(?P<name>[A-Za-z0-9._-]+))?"
     r"(?:/(?P<sub>status|binding|scale|rollback))?$"
 )
+
+
+class _V1Codec:
+    """The native encoding: internal types ARE the v1 wire types."""
+
+    @staticmethod
+    def decode_into(cls, data):
+        return scheme.decode_into(cls, data)
+
+    @staticmethod
+    def encode(obj):
+        return scheme.encode(obj)
+
+    @staticmethod
+    def encode_item(obj):
+        return to_dict(obj)
+
+
+_V1CODEC = _V1Codec()
 
 
 class APIServer:
@@ -164,7 +184,8 @@ class _Handler(BaseHTTPRequestHandler):
         })
 
     def _send_obj(self, obj, code: int = 200):
-        self._send_json(code, scheme.encode(obj))
+        codec = getattr(self, "_codec", _V1CODEC)
+        self._send_json(code, codec.encode(obj))
 
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
@@ -250,7 +271,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         if url.path == "/api":
             return self._send_json(200, {"kind": "APIVersions",
-                                         "versions": ["v1"]})
+                                         "versions": ["v1", "v2"]})
         if url.path == "/apis":
             from kubernetes_tpu.apis import GROUPS
             return self._send_json(200, {
@@ -267,12 +288,29 @@ class _Handler(BaseHTTPRequestHandler):
         sub = m.group("sub")
         group = m.group("group")
         gversion = m.group("gversion")
+        cver = m.group("cver") or ""
 
         # /api/v1/namespaces/{name}/status parses as ns + resource="status":
         # reinterpret as the namespaces status subresource (must happen before
         # authz, which would otherwise see resource="status" ns=<name>)
         if ns and resource == "status" and not name:
             resource, name, sub, ns = "namespaces", ns, "status", ""
+
+        # pick the wire codec: v1 is native; other core versions translate at
+        # the boundary (conversion + defaulting; storage stays internal)
+        self._codec = _V1CODEC
+        if cver and cver != "v1":
+            from kubernetes_tpu.apis import v2 as v2api
+            if cver != v2api.API_VERSION:
+                return self._send_status(404, "NotFound",
+                                         f"unknown API version {cver!r}")
+            if resource != "bindings":  # bindings are version-neutral
+                codec = v2api.codec_for(resource)
+                if codec is None:
+                    return self._send_status(
+                        404, "NotFound",
+                        f"resource {resource!r} is not served at {cver!r}")
+                self._codec = codec
 
         # a group resource must be addressed under its own group prefix and
         # vice versa (reference: per-group route install, master.go:215)
@@ -328,7 +366,8 @@ class _Handler(BaseHTTPRequestHandler):
         if method == "GET":
             return self._send_obj(self.registry.get(resource, name, ns))
         if method == "POST" and not name:
-            obj = scheme.decode_into(RESOURCES[resource].cls, self._read_body())
+            obj = self._codec.decode_into(RESOURCES[resource].cls,
+                                          self._read_body())
             self._admit("CREATE", resource, ns, obj=obj)
             try:
                 created = self.registry.create(resource, obj, namespace=ns)
@@ -341,7 +380,8 @@ class _Handler(BaseHTTPRequestHandler):
         if method == "POST" and sub == "binding":
             return self._serve_binding(ns, pod_name=name)
         if method == "PUT" and name:
-            obj = scheme.decode_into(RESOURCES[resource].cls, self._read_body())
+            obj = self._codec.decode_into(RESOURCES[resource].cls,
+                                          self._read_body())
             self._check_body_matches_url(obj, name, ns)
             if not sub:
                 # subresource writes (status) skip admission, matching the
@@ -499,10 +539,13 @@ class _Handler(BaseHTTPRequestHandler):
         lsel, fsel = self._selectors(q, kind=RESOURCES[resource].kind)
         items, rv = self.registry.list(resource, ns, lsel, fsel)
         rd = RESOURCES[resource]
+        codec = getattr(self, "_codec", _V1CODEC)
+        version = (rd.api_version if codec is _V1CODEC
+                   else getattr(codec, "api_version", "v2"))
         self._send_json(200, {
-            "kind": rd.list_kind, "apiVersion": rd.api_version,
+            "kind": rd.list_kind, "apiVersion": version,
             "metadata": {"resourceVersion": str(rv)},
-            "items": [to_dict(o) for o in items],
+            "items": [codec.encode_item(o) for o in items],
         })
 
     def _serve_binding(self, ns, pod_name: Optional[str] = None):
@@ -559,16 +602,17 @@ class _Handler(BaseHTTPRequestHandler):
                 if out is None:
                     continue
                 etype, obj = out
+                codec = getattr(self, "_codec", _V1CODEC)
                 if binary:
                     # length-delimited binary event frames (reference
                     # protobuf watch framing, pkg/runtime/serializer/
                     # protobuf + util/framer LengthDelimitedFramer)
                     payload = binary_codec.encode_dict(
-                        {"type": etype, "object": scheme.encode(obj)})
+                        {"type": etype, "object": codec.encode(obj)})
                     frame = len(payload).to_bytes(4, "big") + payload
                 else:
                     frame = json.dumps({"type": etype,
-                                        "object": scheme.encode(obj)},
+                                        "object": codec.encode(obj)},
                                        separators=(",", ":")).encode() + b"\n"
                 self._write_chunk(frame)
         except (BrokenPipeError, ConnectionResetError, OSError):

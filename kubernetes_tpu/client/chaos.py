@@ -56,7 +56,12 @@ class NetworkError:
 
 
 class HTTPError:
-    """Short-circuit with an HTTP error status (e.g. a flaky 500/503)."""
+    """Short-circuit with an HTTP error status (e.g. a flaky 500/503).
+
+    Carried as a Status dict so the controller applies the real seam's
+    contract: >=400 raises ApiError EXCEPT 429, which is returned for
+    RESTClient.request()'s retry loop — an injected flow-control shed must
+    recover exactly like a server-sent one."""
 
     def __init__(self, code: int = 500, reason: str = "InternalError",
                  message: str = "chaos"):
@@ -67,11 +72,8 @@ class HTTPError:
     def intervene(self, rng, method: str, path: str) -> Optional[Intervention]:
         return Intervention(
             f"HTTPError({self.code})",
-            error=ApiError(self.code, self.reason, self.message))
-
-    # watch-open interventions surface the same way (ApiError), request-path
-    # interventions too: RESTClient raises ApiError for >=400 statuses, so
-    # raising it directly is indistinguishable from a server-sent error.
+            status={"kind": "Status", "code": self.code,
+                    "reason": self.reason, "message": self.message})
 
 
 class Latency:
@@ -83,6 +85,21 @@ class Latency:
     def intervene(self, rng, method: str, path: str) -> Optional[Intervention]:
         time.sleep(self.seconds)
         return None
+
+
+class Times:
+    """Fire an inner chaos for the first n consultations, then pass through
+    (a bounded outage)."""
+
+    def __init__(self, n: int, inner):
+        self.remaining = n
+        self.inner = inner
+
+    def intervene(self, rng, method: str, path: str) -> Optional[Intervention]:
+        if self.remaining <= 0:
+            return None
+        self.remaining -= 1
+        return self.inner.intervene(rng, method, path)
 
 
 class Probability:
@@ -185,7 +202,13 @@ class ChaosController:
         if iv is not None:
             if self.notifier:
                 self.notifier(iv, "WATCH", path)
-            iv.apply()
+            out = iv.apply()
+            if out is not None:
+                # watch opens have no 429-retry contract: any injected
+                # status is a failed open (the Reflector backs off/re-lists)
+                raise ApiError(out.get("code", 500),
+                               out.get("reason", "Unknown"),
+                               out.get("message", ""))
         return self._orig_watch(resource, namespace, **kw)
 
     # --- lifecycle -----------------------------------------------------------

@@ -18,7 +18,7 @@ from kubernetes_tpu.apiserver import APIServer
 from kubernetes_tpu.client import Informer, ListWatch, RESTClient
 from kubernetes_tpu.client.chaos import (
     ChaosConnectionReset, HTTPError, Latency, NetworkError, PathChaos,
-    Probability, install_chaos,
+    Probability, Times, install_chaos,
 )
 from kubernetes_tpu.client.rest import ApiError
 from kubernetes_tpu.scheduler.factory import ConfigFactory, Scheduler
@@ -86,6 +86,22 @@ class TestChaosChain:
         assert ctl.count("NetworkError") == 1
         assert [(m, p) for _, m, p in ctl.interventions] == [
             ("POST", "/api/v1/namespaces/default/bindings")]
+
+    def test_injected_429_retries_like_a_real_shed(self, server):
+        """A chaos 429 must follow the real seam's contract: RESTClient
+        retries flow-control sheds with backoff instead of raising — so a
+        bounded 429 outage recovers transparently."""
+        c = RESTClient.for_server(server)
+        ctl = install_chaos(c, Times(2, HTTPError(429, "TooManyRequests")))
+        c.list("pods", "default")  # retried through the injected sheds
+        assert ctl.count("HTTPError(429)") == 2
+
+    def test_injected_500_raises_without_retry(self, server):
+        c = RESTClient.for_server(server)
+        ctl = install_chaos(c, Times(1, HTTPError(500)))
+        with pytest.raises(ApiError) as ei:
+            c.list("pods", "default")
+        assert ei.value.code == 500 and ctl.count() == 1
 
     def test_uninstall_heals(self, server):
         c = RESTClient.for_server(server)
