@@ -89,16 +89,20 @@ class Latency:
 
 class Times:
     """Fire an inner chaos for the first n consultations, then pass through
-    (a bounded outage)."""
+    (a bounded outage). Thread-safe: links run outside the controller lock,
+    so the check-and-decrement must be atomic or a shared client's concurrent
+    threads could stretch the outage past n."""
 
     def __init__(self, n: int, inner):
         self.remaining = n
         self.inner = inner
+        self._lock = threading.Lock()
 
     def intervene(self, rng, method: str, path: str) -> Optional[Intervention]:
-        if self.remaining <= 0:
-            return None
-        self.remaining -= 1
+        with self._lock:
+            if self.remaining <= 0:
+                return None
+            self.remaining -= 1
         return self.inner.intervene(rng, method, path)
 
 

@@ -7,3 +7,4 @@ iptables-restore).
 """
 
 from kubernetes_tpu.proxy.proxier import FakeIptables, Proxier
+from kubernetes_tpu.proxy.userspace import LoadBalancerRR, UserspaceProxier

