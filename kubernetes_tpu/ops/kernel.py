@@ -6,34 +6,47 @@ Stage A (assignment-independent, MXU-batched):
   - predicate masks: node selector / NodeAffinity / taints / memory-pressure /
     host pinning — each one matmul + compare over the vocab-encoded tensors
     (predicates.go:416-1002 vectorized)
-  - static inter-pod symmetry with *existing* pods' anti-affinity terms rides
-    the per-step matvec against sym_dom0 (predicates.go:883-921)
   - score ingredients that don't depend on commits: preferred-affinity weight
     counts, intolerable-PreferNoSchedule counts, image-locality buckets
+    (each traced only when the batch actually exercises it — Features)
 
 Stage B (lax.scan over pods in FIFO order):
   replicates the reference's one-pod-at-a-time semantics exactly — each step
   sees capacity/ports/spread/affinity/volume state that includes every prior
-  in-batch commit (the on-device analogue of AssumePod, cache.go:101):
+  in-batch commit (the on-device analogue of AssumePod, cache.go:101).
 
-  - hard inter-pod affinity (predicates.go:769-844): per-term domain-hit rows
-    req_hit[TR,N] carried and max-updated when a committed pod matches the
-    term; the disregard rule (self-selecting term, no match anywhere) uses a
-    carried req_nomatch[TR] flag.
-  - hard anti-affinity + symmetry (predicates.go:858-921): anti_hit[TA,N]
-    forbids term owners; sym_dyn[TA,N] forbids later pods matching an
-    already-committed owner's term (in-batch symmetry); sym_dom0[TS,N] covers
-    existing pods' terms statically.
-  - soft InterPodAffinityPriority (interpod_affinity.go:86-216): forward
-    weighted match counts via carried pref_hit[TP,N]; reverse direction from
-    existing pods via te_dom0[TE,N] (weights pre-folded, incl. the
-    hardPodAffinityWeight for hard terms) and from in-batch commits via
-    te_dyn[TP,N] / hw_dyn[TR,N]; min-max normalized over the feasible set
-    with the window clamped to include 0 (`var maxCount int` starts at 0).
-  - volumes (predicates.go:64-269): NoDiskConflict via carried per-node
-    exclusive-disk occupancy (both-read-only GCE shares legal);
-    MaxPDVolumeCount via carried EBS/GCE attach-column occupancy vs
-    max_ebs/max_gce (union counts, pass when the pod brings no volumes).
+  The scan body is engineered for MINIMAL OP COUNT: on TPU the per-step cost
+  of this loop is dominated by per-op dispatch overhead (~1µs/op measured on
+  v5e), not FLOPs or HBM bandwidth, so semantically-grouped small ops are
+  packed into single fused ops:
+
+  - one [P, W] f32 row ("prow") carries every per-pod operand — requests,
+    group membership, all eight interpod own/match rows, volume/port column
+    ids (as exact f32 integers) — so the scan slices ONE xs leaf per step
+    instead of ~20;
+  - all five vocab occupancy carries (host-ports, exclusive-disk any/rw,
+    EBS and GCE attach columns — predicates.go:64-269,687) live in ONE
+    [5, V, N] array; the per-pod columns are fetched with ONE gather and
+    committed with ONE scatter against reserved always-zero null columns,
+    replacing five [N, V] matvecs + five full-array maximum rewrites;
+  - all six dynamic inter-pod affinity hit tables (req_hit/hw_dyn/anti_hit/
+    sym_dyn/pref_hit/te_dyn — predicates.go:769-947,
+    interpod_affinity.go:86-216) live in ONE [6, T, N] carry contracted by
+    ONE batched dot_general; the two static tables (sym_dom0/te_dom0) by a
+    second. The hard-affinity disregard rule (self-selecting term with no
+    match anywhere, predicates.go:818-844) is linearized:
+    own @ (1 - (hit|dis)) == own·(1-dis) @ (1 - hit) for binary hit/dis,
+    so it rides the same contraction. Commit updates to all six tables are
+    ONE fused elementwise op over the pack (max-rows and add-rows selected
+    by a static mask), fed by ONE batched topo matmul for the three
+    domain-hit rows;
+  - the five masked score reductions (spread max, zone max, interpod
+    min/max, feasibility/zone-presence flags) are ONE [6, N] stacked max.
+
+  Every score ingredient is integer-valued f32 (weights, counts, floored
+  scores), so regrouping sums into batched contractions is bit-exact against
+  the reference formulation — the randomized differential tests
+  (tests/test_tpu_kernel.py) pin this.
 
   Priorities normalize over the *feasible* node set per pod (the reference
   prioritizes only filtered nodes, generic_scheduler.go:94-107). Ties break
@@ -43,7 +56,7 @@ Stage B (lax.scan over pods in FIFO order):
 Feature flags (Features) are computed host-side from the batch and are static
 jit arguments: a batch with no inter-pod terms / volumes / host-ports traces
 none of those carries, so the common case stays a lean
-capacity+spread+affinity scan (no [N,D]-sized HBM traffic per step).
+capacity+spread+affinity scan.
 
 Integer-truncation points match the Go code: calculateScore's
 ((cap-req)*10)/cap, the (cpu+mem)/2 average, int(fScore) everywhere
@@ -69,7 +82,6 @@ from kubernetes_tpu.ops.tensorize import ClusterTensors
 # numpy scalar, not jnp: module import must stay device-free (backend init
 # at import time would grab the chip even for CPU-only test runs)
 NEG = np.float32(-1e9)
-POS = np.float32(1e9)
 
 
 @dataclass(frozen=True)
@@ -87,6 +99,14 @@ class Weights:
     equal: int = 0
 
 
+def _slot_bucket(n: int) -> int:
+    """Bucket a per-pod max column count to a power of two (static jit key
+    stability across similar batches)."""
+    if n <= 0:
+        return 0
+    return 1 << max(0, int(n - 1).bit_length())
+
+
 class Features(NamedTuple):
     """Which optional carries this batch needs (static jit key)."""
 
@@ -100,11 +120,42 @@ class Features(NamedTuple):
     ebs: bool = False        # EBS attach-count columns in play
     gce: bool = False        # GCE-PD attach-count columns in play
     ports: bool = False      # host ports requested by pending pods
+    node_pref: bool = False  # preferred node-affinity terms in play
+    taint_pref: bool = False  # PreferNoSchedule taints in play
+    image: bool = False      # any pod images known (ImageLocality input)
+    sp: int = 0              # max host-port columns per pod (bucketed)
+    sd: int = 0              # max exclusive-disk columns per pod (bucketed)
+    se: int = 0              # max EBS columns per pod (bucketed)
+    sg: int = 0              # max GCE-PD columns per pod (bucketed)
+
+    @property
+    def interpod(self) -> bool:
+        """Any dynamic inter-pod carry traced."""
+        return self.req or self.anti or self.pref or self.hw
+
+    @property
+    def static_terms(self) -> bool:
+        """Any static existing-pod term table traced."""
+        return self.sym or self.te
+
+    @property
+    def vocab(self) -> bool:
+        """Any vocab occupancy carry traced."""
+        return self.ports or self.disk or self.ebs or self.gce
 
 
 def features_of(ct: ClusterTensors) -> Features:
     """Host-side batch inspection -> static trace flags."""
     has_req = bool(ct.req_own.any())
+
+    def _maxcols(mat) -> int:
+        return _slot_bucket(int(np.asarray(mat, np.float32).sum(axis=1).max())
+                            if mat.size else 0)
+
+    ports = bool(ct.pod_ports.any())
+    disk = bool(ct.pod_disk_any.any())
+    ebs = bool(ct.pod_ebs.any())
+    gce = bool(ct.pod_gce.any())
     return Features(
         req=has_req,
         anti=bool(ct.anti_own.any()),
@@ -112,17 +163,28 @@ def features_of(ct: ClusterTensors) -> Features:
         pref=bool(ct.pref_own.any()),
         te=bool(ct.te_dom0.any()),
         hw=has_req and float(ct.hard_weight) > 0,
-        disk=bool(ct.pod_disk_any.any()),
-        ebs=bool(ct.pod_ebs.any()),
-        gce=bool(ct.pod_gce.any()),
-        ports=bool(ct.pod_ports.any()),
+        disk=disk,
+        ebs=ebs,
+        gce=gce,
+        ports=ports,
+        node_pref=bool(ct.pod_pref_term.any()),
+        taint_pref=bool(ct.taints_prefer.any()),
+        image=bool(ct.pod_images.any()),
+        sp=_maxcols(ct.pod_ports) if ports else 0,
+        sd=_maxcols(ct.pod_disk_any) if disk else 0,
+        se=_maxcols(ct.pod_ebs) if ebs else 0,
+        sg=_maxcols(ct.pod_gce) if gce else 0,
     )
 
 
 # --- stage A -----------------------------------------------------------------
 
-def static_pass(t: dict) -> dict:
-    """All [P, N] mask/score ingredients that don't depend on assignment."""
+def static_pass(t: dict, feats: Optional[Features] = None,
+                weights: Optional[Weights] = None) -> dict:
+    """All [P, N] mask/score ingredients that don't depend on assignment.
+
+    With feats/weights given, score rows the batch can't exercise are left
+    out entirely (no [P, N] materialization, no per-step stream)."""
     node_labels = t["node_labels"]          # [N, L]
     N = t["alloc"].shape[0]
 
@@ -144,166 +206,360 @@ def static_pass(t: dict) -> dict:
     static_mask = (
         t["node_valid"][None, :] & sel_ok & aff_ok & taint_ok & mem_ok & host_ok)
 
-    pref_count = (t["pod_pref_term"] * t["pref_weight"][None, :]) @ t["pref_term_node"]
-    taint_pref_count = (1.0 - t["tol_prefer"]) @ t["taints_prefer"].T
-
-    image_mib = t["pod_images"] @ t["image_node_sizes"].T
-    min_mib, max_mib = 23.0, 1000.0
-    image_score = jnp.where(
-        image_mib < min_mib, 0.0,
-        jnp.where(image_mib >= max_mib, 10.0,
-                  jnp.floor(10.0 * (image_mib - min_mib) / (max_mib - min_mib)) + 1.0))
-
-    return {"mask": static_mask, "pref_count": pref_count,
-            "taint_pref_count": taint_pref_count, "image_score": image_score}
+    out = {"mask": static_mask}
+    if feats is None or feats.node_pref:
+        out["pref_count"] = (
+            (t["pod_pref_term"] * t["pref_weight"][None, :]) @ t["pref_term_node"])
+    if feats is None or feats.taint_pref:
+        out["taint_pref_count"] = (1.0 - t["tol_prefer"]) @ t["taints_prefer"].T
+    if feats is None or (feats.image and (weights is None
+                                          or weights.image_locality != 0)):
+        image_mib = t["pod_images"] @ t["image_node_sizes"].T
+        min_mib, max_mib = 23.0, 1000.0
+        out["image_score"] = jnp.where(
+            image_mib < min_mib, 0.0,
+            jnp.where(image_mib >= max_mib, 10.0,
+                      jnp.floor(10.0 * (image_mib - min_mib)
+                                / (max_mib - min_mib)) + 1.0))
+    return out
 
 
 # --- stage B -----------------------------------------------------------------
 
-def _masked_max(x, mask):
-    return jnp.max(jnp.where(mask, x, NEG))
+# vocab pack channel order (fixed): host-ports, exclusive-disk any,
+# exclusive-disk rw, EBS attach, GCE-PD attach
+_CH_PORTS, _CH_DANY, _CH_DRW, _CH_EBS, _CH_GCE = range(5)
 
 
-def _masked_min(x, mask):
-    return jnp.min(jnp.where(mask, x, POS))
+def _extract_cols(mat, slots: int, null_id: int):
+    """[P, V] binary indicator -> ([P, slots] column ids (null_id padded),
+    [P, slots] values at those columns). Runs once per dispatch."""
+    P = mat.shape[0]
+    ids, vals = [], []
+    m = mat
+    rows = jnp.arange(P)
+    for _ in range(slots):
+        i = jnp.argmax(m, axis=1)
+        v = m[rows, i]
+        ids.append(jnp.where(v > 0, i, null_id))
+        vals.append(v)
+        m = m * (1.0 - jax.nn.one_hot(i, mat.shape[1], dtype=m.dtype))
+    return (jnp.stack(ids, axis=1).astype(jnp.float32),
+            jnp.stack(vals, axis=1))
+
+
+def _pack_vocab(t: dict, feats: Features, N: int):
+    """Build the [5, Vp, N] occupancy carry (node state, transposed so the
+    gathered column slices are contiguous) + the per-pod slot streams.
+
+    Vp reserves >=1 always-zero null column: slot entries of pods without
+    that feature point at it, so gathers read zeros and scatters write
+    zeros — no per-slot validity masks needed in the scan body."""
+    widths = [t["node_ports0"].shape[1], t["node_disk_any0"].shape[1],
+              t["node_disk_rw0"].shape[1], t["node_ebs0"].shape[1],
+              t["node_gce0"].shape[1]]
+    V = max(widths)
+    Vp = V + 128  # >=128 guaranteed-zero null columns; null id = V
+
+    def chan(a):  # [N, v] -> [Vp, N]
+        a = a.T
+        return jnp.pad(a, ((0, Vp - a.shape[0]), (0, 0)))
+
+    vocab0 = jnp.stack([
+        chan(t["node_ports0"]), chan(t["node_disk_any0"]),
+        chan(t["node_disk_rw0"]), chan(t["node_ebs0"]), chan(t["node_gce0"])])
+
+    # unified slot list: (static channel, per-pod id, per-pod commit value)
+    chans: List[int] = []
+    id_cols, val_cols = [], []
+    if feats.ports:
+        ids, vals = _extract_cols(t["pod_ports"], feats.sp, V)
+        for s in range(feats.sp):
+            chans.append(_CH_PORTS)
+            id_cols.append(ids[:, s])
+            val_cols.append(vals[:, s])
+    if feats.disk:
+        ids, vals = _extract_cols(t["pod_disk_any"], feats.sd, V)
+        rows = jnp.arange(t["pod_disk_rw"].shape[0])
+        for s in range(feats.sd):
+            rw = t["pod_disk_rw"][rows, ids[:, s].astype(jnp.int32)
+                                  % t["pod_disk_rw"].shape[1]]
+            rw = rw * vals[:, s]
+            # two slots per disk column: the any-channel (commit value 1)
+            # and the rw-channel (commit value = pod's rw flag)
+            chans.append(_CH_DANY)
+            id_cols.append(ids[:, s])
+            val_cols.append(vals[:, s])
+            chans.append(_CH_DRW)
+            id_cols.append(ids[:, s])
+            val_cols.append(rw)
+    if feats.ebs:
+        ids, vals = _extract_cols(t["pod_ebs"], feats.se, V)
+        for s in range(feats.se):
+            chans.append(_CH_EBS)
+            id_cols.append(ids[:, s])
+            val_cols.append(vals[:, s])
+    if feats.gce:
+        ids, vals = _extract_cols(t["pod_gce"], feats.sg, V)
+        for s in range(feats.sg):
+            chans.append(_CH_GCE)
+            id_cols.append(ids[:, s])
+            val_cols.append(vals[:, s])
+
+    slot_ids = jnp.stack(id_cols, axis=1)     # [P, SS] f32 (exact ints)
+    slot_vals = jnp.stack(val_cols, axis=1)   # [P, SS] f32
+    chan_idx = np.asarray(chans, np.int32)    # [SS] static
+    return vocab0, chan_idx, slot_ids, slot_vals
+
+
+class _Layout:
+    """Static offsets into the packed per-pod row."""
+
+    def __init__(self):
+        self.off = 0
+        self.spans: Dict[str, slice] = {}
+
+    def add(self, name: str, width: int) -> None:
+        self.spans[name] = slice(self.off, self.off + width)
+        self.off += width
+
+    def of(self, row, name: str):
+        return row[self.spans[name]]
 
 
 def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
-    """lax.scan over pods; returns assignments [P] i32 (-1 = unschedulable)."""
+    """lax.scan over pods; returns assignments [P] i32 (-1 = unschedulable).
+
+    Exactly the reference's sequential semantics (scheduler.go:93-155 one
+    pod at a time over generic_scheduler.go:70-133), with the per-step work
+    packed into ~25 fused ops (see module docstring)."""
     assert not feats.hw or feats.req, "hw carry requires the req term table"
     alloc = t["alloc"]                      # [N, 4]
     N = alloc.shape[0]
-    zone_id = t["zone_id"]                  # [N]
+    G = t["group_counts0"].shape[1]
     Z = int(t["n_zones"]) if isinstance(t["n_zones"], int) else t["n_zones"]
     idx_n = jnp.arange(N, dtype=jnp.int32)
 
-    zero_req = jnp.all(t["req"][:, :3] == 0.0, axis=1)  # pods axis excluded
+    use_ip = feats.interpod
+    use_st = feats.static_terms
+    use_vocab = feats.vocab
+    use_image = feats.image and w.image_locality != 0
 
-    # zone membership one-hot; zone counts are recomputed per step over the
-    # *feasible* node set (the reference sums countsByZone over filtered
-    # nodes only, selector_spreading.go:186-196)
-    zone_onehot = ((zone_id[:, None] == jnp.arange(Z)[None, :])
-                   & (zone_id >= 0)[:, None]).astype(jnp.float32)  # [N, Z]
+    # ---- prologue: one-time packing (runs on device, once per dispatch) ----
+    allocT = alloc.T                        # [4, N]
+    cap_c, cap_m = allocT[0], allocT[1]
 
-    # static interpod operands captured by the step closure
-    node_dom = t["node_dom"]                # [K, N] i32
-    sym_dom0 = t["sym_dom0"]                # [TS, N]
-    te_dom0 = t["te_dom0"]                  # [TE, N]
-    pref_w = t["pref_w"]                    # [TP]
-    hard_w = t["hard_weight"]               # [] f32
+    # zone membership (spread's zone blend recomputes per step over the
+    # feasible set — selector_spreading.go:186-196)
+    zone_onehot_t = ((t["zone_id"][None, :] == jnp.arange(Z)[:, None])
+                     & (t["zone_id"] >= 0)[None, :]).astype(jnp.float32)  # [Z,N]
 
-    use_dm = feats.req or feats.anti or feats.pref
-    use_ip_score = feats.pref or feats.te or feats.hw
+    # node state pack [R, N]: used(4) | used_nz(2) | ebs_count | gce_count |
+    # gcounts(G) | null group row
+    nstate0 = jnp.concatenate([
+        t["used0"].T, t["used0_nonzero"].T,
+        jnp.sum(t["node_ebs0"], axis=1)[None, :],
+        jnp.sum(t["node_gce0"], axis=1)[None, :],
+        t["group_counts0"].T, jnp.zeros((1, N), jnp.float32)], axis=0)
+    _R_EBS, _R_GCE, _R_G0 = 6, 7, 8
+    null_group = G  # relative to gcounts rows
 
-    xs = {
-        "req": t["req"], "nz": t["nonzero_req"],
-        "mask": s["mask"], "pref": s["pref_count"],
-        "taint_pref": s["taint_pref_count"], "image": s["image_score"],
-        "group": t["pod_group"], "in_group": t["pod_in_group"],
-        "valid": t["pod_valid"], "zero_req": zero_req,
-    }
-    if feats.ports:
-        xs["ports"] = t["pod_ports"]
-    if feats.req:
-        xs["req_own"] = t["req_own"]                  # [P, TR]
-        xs["req_matchT"] = t["req_match"].T           # [P, TR]
-    if feats.anti:
-        xs["anti_own"] = t["anti_own"]                # [P, TA]
-        xs["anti_matchT"] = t["anti_match"].T         # [P, TA]
-    if feats.pref:
-        xs["pref_own"] = t["pref_own"]                # [P, TP]
-        xs["pref_matchT"] = t["pref_match"].T         # [P, TP]
-    if feats.sym:
-        xs["sym_matchT"] = t["sym_match"].T           # [P, TS]
-    if feats.te:
-        xs["te_matchT"] = t["te_match"].T             # [P, TE]
-    if feats.disk:
-        xs["disk_any"] = t["pod_disk_any"]            # [P, D]
-        xs["disk_rw"] = t["pod_disk_rw"]              # [P, D]
-    if feats.ebs:
-        xs["ebs"] = t["pod_ebs"]                      # [P, VE]
-    if feats.gce:
-        xs["gce"] = t["pod_gce"]                      # [P, VG]
+    if use_vocab:
+        vocab0, chan_idx, slot_ids, slot_vals = _pack_vocab(t, feats, N)
+        SS = chan_idx.shape[0]
+    else:
+        SS = 0
 
-    init = {
-        "used": t["used0"], "used_nz": t["used0_nonzero"],
-        "gcounts": t["group_counts0"], "rr": jnp.int32(0),
-    }
-    if feats.ports:
-        init["ports"] = t["node_ports0"]
-    if feats.req:
-        init["req_hit"] = t["req_hit0"]               # [TR, N]
-        init["req_nomatch"] = t["req_nomatch0"]       # [TR] bool
-    if feats.hw:
-        init["hw_dyn"] = jnp.zeros_like(t["req_hit0"])
-    if feats.anti:
-        init["anti_hit"] = t["anti_hit0"]             # [TA, N]
-        init["sym_dyn"] = jnp.zeros_like(t["anti_hit0"])
-    if feats.pref:
-        init["pref_hit"] = t["pref_hit0"]             # [TP, N]
-        init["te_dyn"] = jnp.zeros_like(t["pref_hit0"])
-    if feats.disk:
-        init["disk_any"] = t["node_disk_any0"]        # [N, D]
-        init["disk_rw"] = t["node_disk_rw0"]          # [N, D]
-    if feats.ebs:
-        init["ebs_occ"] = t["node_ebs0"]              # [N, VE]
-    if feats.gce:
-        init["gce_occ"] = t["node_gce0"]              # [N, VG]
+    if use_ip:
+        T = max(t["req_own"].shape[1], t["anti_own"].shape[1],
+                t["pref_own"].shape[1])
 
-    wf = {k: jnp.float32(v) for k, v in w.__dict__.items()}
+        def padT(a, rows_axis0=True):  # pad term axis to T
+            if rows_axis0:  # [Tx, N] -> [T, N]
+                return jnp.pad(a, ((0, T - a.shape[0]), (0, 0)))
+            return jnp.pad(a, ((0, 0), (0, T - a.shape[1])))  # [P, Tx] -> [P, T]
+
+        # req/anti hit rows binarize (only `>0` is ever tested; the
+        # incremental mirror ships them as decrement-able counts) — required
+        # for the linearized disregard contraction, which needs 0/1 values
+        hits0 = jnp.stack([
+            (padT(t["req_hit0"]) > 0).astype(jnp.float32),
+            jnp.zeros((T, N), jnp.float32),
+            (padT(t["anti_hit0"]) > 0).astype(jnp.float32),
+            jnp.zeros((T, N), jnp.float32),
+            padT(t["pref_hit0"]), jnp.zeros((T, N), jnp.float32)])  # [6, T, N]
+        # add-rows vs max-rows of the hit pack (static selector)
+        hit_is_max = np.asarray([1, 0, 1, 1, 0, 0], bool)[:, None, None]
+        topo_stack = jnp.concatenate([
+            jnp.pad(t["req_topo"], ((0, T - t["req_topo"].shape[0]), (0, 0))),
+            jnp.pad(t["anti_topo"], ((0, T - t["anti_topo"].shape[0]), (0, 0))),
+            jnp.pad(t["pref_topo"], ((0, T - t["pref_topo"].shape[0]), (0, 0))),
+        ], axis=0)                                            # [3T, K]
+        req_nomatch0 = jnp.pad(t["req_nomatch0"],
+                               (0, T - t["req_nomatch0"].shape[0]))
+        pref_w = jnp.pad(t["pref_w"], (0, T - t["pref_w"].shape[0]))
+        node_dom = t["node_dom"]                              # [K, N] i32
+        hard_w = t["hard_weight"]
+    if use_st:
+        T2 = max(t["sym_dom0"].shape[0], t["te_dom0"].shape[0])
+
+        def padT2(a):
+            return jnp.pad(a, ((0, T2 - a.shape[0]), (0, 0)))
+
+        static2 = jnp.stack([padT2(t["sym_dom0"]), padT2(t["te_dom0"])])
+
+    # ---- the packed per-pod row (ONE xs leaf sliced per step) --------------
+    lay = _Layout()
+    pieces = []
+
+    def put(name, arr2d):
+        lay.add(name, arr2d.shape[1])
+        pieces.append(arr2d.astype(jnp.float32))
+
+    put("req", t["req"])                                     # 4
+    put("nz", t["nonzero_req"])                              # 2
+    zero_req = jnp.all(t["req"][:, :3] == 0.0, axis=1)
+    put("flags", jnp.stack([
+        zero_req.astype(jnp.float32),
+        t["pod_valid"].astype(jnp.float32),
+        (t["pod_group"] >= 0).astype(jnp.float32),
+        jnp.where(t["pod_group"] >= 0, t["pod_group"], null_group
+                  ).astype(jnp.float32)], axis=1))           # 4
+    put("in_group", jnp.pad(t["pod_in_group"], ((0, 0), (0, 1))))  # G+1
+    if use_vocab:
+        put("slot_ids", slot_ids)                            # SS
+        put("slot_vals", slot_vals)                          # SS
+        put("vol_cnt", jnp.stack([
+            jnp.sum(t["pod_ebs"], axis=1),
+            jnp.sum(t["pod_gce"], axis=1)], axis=1))         # 2
+    if use_ip:
+        put("req_own", padT(t["req_own"], False))
+        put("req_match", padT(t["req_match"].T, False))
+        put("anti_own", padT(t["anti_own"], False))
+        put("anti_match", padT(t["anti_match"].T, False))
+        put("pref_own", padT(t["pref_own"], False))
+        put("pref_match", padT(t["pref_match"].T, False))
+    if use_st:
+        lay.add("sym_match", T2)
+        pieces.append(jnp.pad(t["sym_match"].T,
+                              ((0, 0), (0, T2 - t["sym_match"].shape[0]))))
+        lay.add("te_match", T2)
+        pieces.append(jnp.pad(t["te_match"].T,
+                              ((0, 0), (0, T2 - t["te_match"].shape[0]))))
+    prow = jnp.concatenate(pieces, axis=1)                   # [P, W]
+
+    xs = {"prow": prow, "mask": s["mask"]}
+    if feats.node_pref:
+        xs["pref"] = s["pref_count"]
+    if feats.taint_pref:
+        xs["taint_pref"] = s["taint_pref_count"]
+    if use_image:
+        xs["image"] = s["image_score"]
+
+    init = {"nstate": nstate0, "rr": jnp.int32(0)}
+    if use_vocab:
+        init["vocab"] = vocab0
+    if use_ip:
+        init["hits"] = hits0
+        init["req_nomatch"] = req_nomatch0
+
+    wf = {k: np.float32(v) for k, v in w.__dict__.items()}
 
     def step(carry, x):
-        used, used_nz, gcounts, rr = (
-            carry["used"], carry["used_nz"], carry["gcounts"], carry["rr"])
+        nstate, rr = carry["nstate"], carry["rr"]
+        row = x["prow"]
+        g = lay.of(row, "flags")[3].astype(jnp.int32)
+        req_v = lay.of(row, "req")
+        nz_v = lay.of(row, "nz")
+        flags = lay.of(row, "flags")
+        zero_req_f, valid_f, has_group_f = flags[0], flags[1], flags[2]
 
-        # --- dynamic predicates (PodFitsResources + ports) -------------------
-        pod_count_ok = used[:, 3] + 1.0 <= alloc[:, 3]
-        res_fit = jnp.all(used[:, :3] + x["req"][None, :3] <= alloc[:, :3], axis=1)
-        res_ok = x["zero_req"] | res_fit        # zero-request: count-only
-        mask = x["mask"] & pod_count_ok & res_ok
-        if feats.ports:
-            mask = mask & ((carry["ports"] @ x["ports"]) == 0.0)
+        # --- dynamic predicates (PodFitsResources) ---------------------------
+        used = nstate[:4]                   # [4, N]
+        used_nz = nstate[4:6]
+        pod_count_ok = used[3] + 1.0 <= allocT[3]
+        res_fit = jnp.all(used[:3] + req_v[:3, None] <= allocT[:3], axis=0)
+        mask = x["mask"] & pod_count_ok & ((zero_req_f > 0) | res_fit)
 
-        # --- volumes (predicates.go:64-269) ----------------------------------
-        if feats.disk:
-            # conflict unless every shared column is read-only on both sides:
-            # pod-rw vs node-any plus pod-any vs node-rw covers "not both ro"
-            clash = (carry["disk_any"] @ x["disk_rw"]
-                     + carry["disk_rw"] @ x["disk_any"])
-            mask = mask & (clash == 0.0)
-        if feats.ebs:
-            pod_cnt = jnp.sum(x["ebs"])
-            union = (jnp.sum(carry["ebs_occ"], axis=1) + pod_cnt
-                     - carry["ebs_occ"] @ x["ebs"])
-            mask = mask & ((pod_cnt == 0.0) | (union <= t["max_ebs"]))
-        if feats.gce:
-            pod_cnt = jnp.sum(x["gce"])
-            union = (jnp.sum(carry["gce_occ"], axis=1) + pod_cnt
-                     - carry["gce_occ"] @ x["gce"])
-            mask = mask & ((pod_cnt == 0.0) | (union <= t["max_gce"]))
+        # --- vocab features: ports + volumes (predicates.go:64-269,687) ------
+        if use_vocab:
+            vocab = carry["vocab"]
+            sids = lay.of(row, "slot_ids").astype(jnp.int32)   # [SS]
+            svals = lay.of(row, "slot_vals")                   # [SS]
+            cols = vocab[chan_idx, sids, :]                    # [SS, N]
+            port_clash = jnp.zeros((N,), jnp.float32)
+            disk_clash = jnp.zeros((N,), jnp.float32)
+            ebs_hit = jnp.zeros((N,), jnp.float32)
+            gce_hit = jnp.zeros((N,), jnp.float32)
+            for si, ch in enumerate(chan_idx):
+                if ch == _CH_PORTS:
+                    port_clash = port_clash + cols[si]
+                elif ch == _CH_DANY:
+                    # node-any column x pod rw flag (the rw slot value
+                    # directly follows in the slot list)
+                    disk_clash = disk_clash + cols[si] * svals[si + 1]
+                elif ch == _CH_DRW:
+                    # node-rw column x pod any flag
+                    disk_clash = disk_clash + cols[si] * svals[si - 1]
+                elif ch == _CH_EBS:
+                    ebs_hit = ebs_hit + cols[si]
+                else:
+                    gce_hit = gce_hit + cols[si]
+            if feats.ports:
+                mask = mask & (port_clash == 0.0)
+            if feats.disk:
+                mask = mask & (disk_clash == 0.0)
+            if feats.ebs:
+                cnt_e = lay.of(row, "vol_cnt")[0]
+                union = nstate[_R_EBS] + cnt_e - ebs_hit
+                mask = mask & ((cnt_e == 0.0) | (union <= t["max_ebs"]))
+            if feats.gce:
+                cnt_g = lay.of(row, "vol_cnt")[1]
+                union = nstate[_R_GCE] + cnt_g - gce_hit
+                mask = mask & ((cnt_g == 0.0) | (union <= t["max_gce"]))
 
-        # --- hard inter-pod affinity (predicates.go:769-844) -----------------
-        if feats.req:
-            # per-term ok: a matching pod in this node's domain, or the
-            # disregard rule (self-selecting term, no match anywhere)
-            disregard = (x["req_matchT"] > 0) & carry["req_nomatch"]
-            term_ok = (carry["req_hit"] > 0) | disregard[:, None]
-            viol = x["req_own"] @ (1.0 - term_ok.astype(jnp.float32))
+        # --- inter-pod affinity: mask + score in two contractions ------------
+        # (predicates.go:769-921, interpod_affinity.go:86-216)
+        viol = None
+        c = None
+        if use_ip:
+            hits = carry["hits"]
+            req_own_v = lay.of(row, "req_own")
+            req_match_v = lay.of(row, "req_match")
+            anti_own_v = lay.of(row, "anti_own")
+            anti_match_v = lay.of(row, "anti_match")
+            pref_own_v = lay.of(row, "pref_own")
+            pref_match_v = lay.of(row, "pref_match")
+            # disregard rule: own @ (1-(hit|dis)) == (own·(1-dis)) @ (1-hit)
+            # for binary hit/dis (predicates.go:818-844)
+            disregard = ((req_match_v > 0) & carry["req_nomatch"]
+                         ).astype(jnp.float32)
+            own_eff = req_own_v * (1.0 - disregard)            # [T]
+            lhs6 = jnp.stack([
+                -own_eff,                    # row0: req violations (negated)
+                hard_w * req_match_v,        # row1: reverse-hard score
+                anti_own_v,                  # row2: anti violations
+                anti_match_v,                # row3: in-batch symmetry
+                pref_own_v * pref_w,         # row4: forward preferred score
+                pref_match_v,                # row5: reverse preferred score
+            ])[:, None, :]                                     # [6, 1, T]
+            ip6 = jax.lax.dot_general(
+                lhs6, hits, (((2,), (1,)), ((0,), (0,))))[:, 0, :]  # [6, N]
+            viol = jnp.sum(own_eff) + ip6[0] + ip6[2] + ip6[3]
+            c = ip6[1] + ip6[4] + ip6[5]
+        if use_st:
+            lhs2 = jnp.stack([lay.of(row, "sym_match"),
+                              lay.of(row, "te_match")])[:, None, :]
+            ip2 = jax.lax.dot_general(
+                lhs2, static2, (((2,), (1,)), ((0,), (0,))))[:, 0, :]  # [2, N]
+            viol = ip2[0] if viol is None else viol + ip2[0]
+            c = ip2[1] if c is None else c + ip2[1]
+        if viol is not None:
             mask = mask & (viol == 0.0)
-        # --- anti-affinity + symmetry (predicates.go:858-921) ----------------
-        if feats.anti:
-            v = (x["anti_own"] @ carry["anti_hit"]
-                 + x["anti_matchT"] @ carry["sym_dyn"])
-            mask = mask & (v == 0.0)
-        if feats.sym:
-            mask = mask & ((x["sym_matchT"] @ sym_dom0) == 0.0)
-
-        feasible = jnp.any(mask) & x["valid"]
 
         # --- dynamic scores --------------------------------------------------
-        cap_c, cap_m = alloc[:, 0], alloc[:, 1]
-        tot_c = used_nz[:, 0] + x["nz"][0]
-        tot_m = used_nz[:, 1] + x["nz"][1]
+        tot_c = used_nz[0] + nz_v[0]
+        tot_m = used_nz[1] + nz_v[1]
         cpu_sc = jnp.where((cap_c > 0) & (tot_c <= cap_c),
                            jnp.floor((cap_c - tot_c) * 10.0 / cap_c), 0.0)
         mem_sc = jnp.where((cap_m > 0) & (tot_m <= cap_m),
@@ -315,125 +571,138 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
         balanced = jnp.where((frac_c >= 1.0) | (frac_m >= 1.0), 0.0,
                              jnp.floor(10.0 - jnp.abs(frac_c - frac_m) * 10.0))
 
-        # spread (maxes over the *feasible* node set, like the reference's
-        # filtered-node prioritization)
-        g = x["group"]
-        has_group = g >= 0
-        counts = jnp.where(has_group, gcounts[:, jnp.maximum(g, 0)], 0.0)
-        maxc = jnp.maximum(_masked_max(counts, mask), 0.0)
+        # spread counts for this pod's group (null row when none)
+        counts = jax.lax.dynamic_slice(
+            nstate, (_R_G0 + g, jnp.int32(0)), (1, N))[0]
+        zsum = zone_onehot_t @ jnp.where(mask, counts, 0.0)    # [Z]
+        node_zc = zsum @ zone_onehot_t                         # [N]
+
+        # --- ONE stacked masked reduction for all per-step maxima ------------
+        maskf = mask
+        stack_rows = [
+            jnp.where(maskf, counts, NEG),                     # 0: maxc
+            jnp.where(maskf & (t["zone_id"] >= 0), node_zc, NEG),  # 1: maxz
+            jnp.where(maskf, 1.0, NEG),                        # 2: feasible
+            jnp.where(maskf & (t["zone_id"] >= 0), 1.0, NEG),  # 3: have_zones
+        ]
+        ri = {"maxc": 0, "maxz": 1, "feas": 2, "zones": 3}
+        if c is not None:
+            ri["ipmax"] = len(stack_rows)
+            stack_rows.append(jnp.where(maskf, c, NEG))
+            ri["ipmin"] = len(stack_rows)
+            stack_rows.append(jnp.where(maskf, -c, NEG))
+        if feats.node_pref:
+            ri["pref"] = len(stack_rows)
+            stack_rows.append(jnp.where(maskf, x["pref"], NEG))
+        if feats.taint_pref:
+            ri["tp"] = len(stack_rows)
+            stack_rows.append(jnp.where(maskf, x["taint_pref"], NEG))
+        mx = jnp.max(jnp.stack(stack_rows), axis=1)            # [rows]
+
+        feasible = (mx[ri["feas"]] > 0.0) & (valid_f > 0)
+        maxc = jnp.maximum(mx[ri["maxc"]], 0.0)
         fscore = jnp.where(maxc > 0.0, 10.0 * (maxc - counts) / maxc, 10.0)
-        # zone sums over feasible nodes only (filtered-node semantics)
-        zsum = (jnp.where(mask, counts, 0.0) @ zone_onehot)          # [Z]
-        node_zc = zsum[jnp.maximum(zone_id, 0)]
-        maxz = jnp.maximum(_masked_max(jnp.where(zone_id >= 0, node_zc, NEG), mask), 0.0)
+        maxz = jnp.maximum(mx[ri["maxz"]], 0.0)
         zscore = jnp.where(maxz > 0.0, 10.0 * (maxz - node_zc) / maxz, 10.0)
-        have_zones = jnp.any(mask & (zone_id >= 0))  # zones among feasible nodes
-        blend = jnp.where((zone_id >= 0) & has_group & have_zones & (maxz > 0.0),
+        have_zones = mx[ri["zones"]] > 0.0
+        has_group = has_group_f > 0
+        blend = jnp.where((t["zone_id"] >= 0) & has_group & have_zones
+                          & (maxz > 0.0),
                           fscore * (1.0 / 3.0) + (2.0 / 3.0) * zscore, fscore)
         spread = jnp.floor(jnp.where(has_group, blend, 10.0))
 
-        # node-affinity preferred (normalized over feasible set)
-        max_pref = _masked_max(x["pref"], mask)
-        node_aff = jnp.where(max_pref > 0.0,
-                             jnp.floor(10.0 * x["pref"] / max_pref), 0.0)
-
-        # taint PreferNoSchedule (normalized over feasible set)
-        max_tp = _masked_max(x["taint_pref"], mask)
-        taint_sc = jnp.where(max_tp > 0.0,
-                             jnp.floor((1.0 - x["taint_pref"] / max_tp) * 10.0), 10.0)
-
-        # soft inter-pod affinity (interpod_affinity.go:86-216): forward
-        # weighted matches + reverse preferences of placed pods about us,
-        # min-max normalized over the feasible set with 0 in the window
-        if use_ip_score:
-            c = jnp.zeros((N,), jnp.float32)
-            if feats.pref:
-                c = c + (x["pref_own"] * pref_w) @ carry["pref_hit"]
-                c = c + x["pref_matchT"] @ carry["te_dyn"]
-            if feats.te:
-                c = c + x["te_matchT"] @ te_dom0
-            if feats.hw:
-                c = c + hard_w * (x["req_matchT"] @ carry["hw_dyn"])
-            ip_max = jnp.maximum(_masked_max(c, mask), 0.0)
-            ip_min = jnp.minimum(_masked_min(c, mask), 0.0)
-            ip_rng = ip_max - ip_min
-            interpod = jnp.where(ip_rng > 0.0,
-                                 jnp.floor(10.0 * (c - ip_min) / ip_rng), 0.0)
-        else:
-            interpod = 0.0
-
         score = (wf["least_requested"] * least + wf["balanced"] * balanced
-                 + wf["spread"] * spread + wf["node_affinity"] * node_aff
-                 + wf["taint_toleration"] * taint_sc
-                 + wf["interpod_affinity"] * interpod
-                 + wf["image_locality"] * x["image"] + wf["equal"] * 1.0)
+                 + wf["spread"] * spread + wf["equal"] * 1.0)
+        if feats.node_pref:
+            max_pref = mx[ri["pref"]]
+            score = score + wf["node_affinity"] * jnp.where(
+                max_pref > 0.0, jnp.floor(10.0 * x["pref"] / max_pref), 0.0)
+        if feats.taint_pref:
+            max_tp = mx[ri["tp"]]
+            score = score + wf["taint_toleration"] * jnp.where(
+                max_tp > 0.0,
+                jnp.floor((1.0 - x["taint_pref"] / max_tp) * 10.0), 10.0)
+        else:
+            # constant 10 for every feasible node — shifts all candidates
+            # equally, so the argmax/tie set is unchanged; omitted
+            pass
+        if c is not None:
+            ip_max = jnp.maximum(mx[ri["ipmax"]], 0.0)
+            ip_min = jnp.minimum(-mx[ri["ipmin"]], 0.0)
+            ip_rng = ip_max - ip_min
+            score = score + wf["interpod_affinity"] * jnp.where(
+                ip_rng > 0.0, jnp.floor(10.0 * (c - ip_min) / ip_rng), 0.0)
+        if use_image:
+            score = score + wf["image_locality"] * x["image"]
 
         # --- selectHost: max + round-robin tie-break -------------------------
         masked_score = jnp.where(mask, score, NEG)
         max_score = jnp.max(masked_score)
         is_max = mask & (masked_score == max_score)
-        n_ties = jnp.sum(is_max.astype(jnp.int32))
-        k = jnp.where(n_ties > 0, rr % jnp.maximum(n_ties, 1), 0)
         cum = jnp.cumsum(is_max.astype(jnp.int32))
-        chosen = jnp.argmax(is_max & (cum == k + 1))
-        chosen = jnp.where(feasible, chosen.astype(jnp.int32), jnp.int32(-1))
+        n_ties = cum[N - 1]
+        k = jnp.where(n_ties > 0, rr % jnp.maximum(n_ties, 1), 0)
+        chosen = jnp.argmax(is_max & (cum == k + 1)).astype(jnp.int32)
+        chosen = jnp.where(feasible, chosen, jnp.int32(-1))
 
         # --- commit (the on-device AssumePod) --------------------------------
         commit = feasible
-        onehot = ((idx_n == chosen) & commit).astype(jnp.float32)
-        used = used + onehot[:, None] * x["req"][None, :]
-        used_nz = used_nz + onehot[:, None] * x["nz"][None, :]
-        gcounts = gcounts + onehot[:, None] * x["in_group"][None, :]
-        rr = rr + commit.astype(jnp.int32)
+        commitf = commit.astype(jnp.float32)
+        safe = jnp.maximum(chosen, 0)
+        onehot = ((idx_n == safe).astype(jnp.float32)) * commitf
 
-        out = {"used": used, "used_nz": used_nz, "gcounts": gcounts, "rr": rr}
-        if feats.ports:
-            out["ports"] = jnp.maximum(
-                carry["ports"], onehot[:, None] * x["ports"][None, :])
+        if use_vocab:
+            col_at = jax.lax.dynamic_slice(
+                cols, (0, safe), (cols.shape[0], 1))[:, 0]     # [SS]
+            if feats.ebs:
+                ebs_at = jnp.sum(jnp.where(chan_idx == _CH_EBS, col_at, 0.0))
+                ebs_inc = (cnt_e - ebs_at) * commitf
+            else:
+                ebs_inc = 0.0
+            if feats.gce:
+                gce_at = jnp.sum(jnp.where(chan_idx == _CH_GCE, col_at, 0.0))
+                gce_inc = (cnt_g - gce_at) * commitf
+            else:
+                gce_inc = 0.0
+        else:
+            ebs_inc = gce_inc = 0.0
 
-        if use_dm:
-            # nodes sharing a topology domain with the chosen node, per key
-            # (zeroed when nothing committed, so all updates no-op)
-            safe = jnp.maximum(chosen, 0)
-            dom_c = node_dom[:, safe]                            # [K]
-            eq = ((node_dom == dom_c[:, None]) & (node_dom >= 0)
-                  ).astype(jnp.float32) * commit.astype(jnp.float32)  # [K, N]
-        if feats.req:
-            dm = ((t["req_topo"] @ eq) > 0).astype(jnp.float32)  # [TR, N]
-            qmatch = x["req_matchT"]
-            out["req_hit"] = jnp.maximum(carry["req_hit"],
-                                         qmatch[:, None] * dm)
-            out["req_nomatch"] = carry["req_nomatch"] & ~((qmatch > 0) & commit)
-            if feats.hw:
-                out["hw_dyn"] = carry["hw_dyn"] + x["req_own"][:, None] * dm
-        if feats.anti:
-            dm = ((t["anti_topo"] @ eq) > 0).astype(jnp.float32)
-            out["anti_hit"] = jnp.maximum(carry["anti_hit"],
-                                          x["anti_matchT"][:, None] * dm)
-            out["sym_dyn"] = jnp.maximum(
-                carry["sym_dyn"],
-                (x["anti_own"] > 0).astype(jnp.float32)[:, None] * dm)
-        if feats.pref:
-            dm = ((t["pref_topo"] @ eq) > 0).astype(jnp.float32)
-            out["pref_hit"] = carry["pref_hit"] + x["pref_matchT"][:, None] * dm
-            out["te_dyn"] = (carry["te_dyn"]
-                             + (x["pref_own"] * pref_w)[:, None] * dm)
-        if feats.disk:
-            out["disk_any"] = jnp.maximum(
-                carry["disk_any"], onehot[:, None] * x["disk_any"][None, :])
-            out["disk_rw"] = jnp.maximum(
-                carry["disk_rw"], onehot[:, None] * x["disk_rw"][None, :])
-        if feats.ebs:
-            out["ebs_occ"] = jnp.maximum(
-                carry["ebs_occ"], onehot[:, None] * x["ebs"][None, :])
-        if feats.gce:
-            out["gce_occ"] = jnp.maximum(
-                carry["gce_occ"], onehot[:, None] * x["gce"][None, :])
+        inc = jnp.concatenate([
+            req_v, nz_v,
+            jnp.stack([jnp.asarray(ebs_inc, jnp.float32),
+                       jnp.asarray(gce_inc, jnp.float32)]),
+            lay.of(row, "in_group")]) * commitf                # [R]
+        out = {"nstate": nstate + inc[:, None] * onehot[None, :],
+               "rr": rr + commit.astype(jnp.int32)}
+
+        if use_vocab:
+            out["vocab"] = vocab.at[chan_idx, sids, safe].max(svals * commitf)
+
+        if use_ip:
+            dom_c = jax.lax.dynamic_slice(
+                node_dom, (0, safe), (node_dom.shape[0], 1))   # [K, 1]
+            eq = (((node_dom == dom_c) & (node_dom >= 0))
+                  .astype(jnp.float32) * commitf)              # [K, N]
+            dm3 = ((topo_stack @ eq) > 0).astype(jnp.float32)  # [3T, N]
+            dm6 = jnp.repeat(dm3.reshape(3, T, N), 2, axis=0)  # [6, T, N]
+            coef6 = jnp.stack([
+                req_match_v,                  # row0 req_hit (max)
+                req_own_v,                    # row1 hw_dyn (add)
+                anti_match_v,                 # row2 anti_hit (max)
+                (anti_own_v > 0).astype(jnp.float32),  # row3 sym_dyn (max)
+                pref_match_v,                 # row4 pref_hit (add)
+                pref_own_v * pref_w,          # row5 te_dyn (add)
+            ])                                                 # [6, T]
+            U = coef6[:, :, None] * dm6
+            out["hits"] = jnp.where(hit_is_max,
+                                    jnp.maximum(hits, U), hits + U)
+            out["req_nomatch"] = carry["req_nomatch"] & ~(
+                (req_match_v > 0) & commit)
 
         return out, chosen
 
     # unroll amortizes per-iteration loop overhead; the body is tiny
-    # (elementwise over N + a few [T, N] matvecs) so overhead dominates
+    # (elementwise over N + a few [T, N] contractions) so overhead dominates
     _, assignments = jax.lax.scan(step, init, xs, unroll=8)
     return assignments
 
@@ -458,7 +727,7 @@ def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
         else:
             t[k] = v.astype(jnp.float32)
     t["n_zones"] = n_zones
-    s = static_pass(t)
+    s = static_pass(t, feats, weights)
     return greedy_commit(t, s, weights, feats)
 
 

@@ -100,6 +100,45 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(name, {}).get(_label_key(labels), 0.0)
 
+    def hist_total(self, name: str) -> int:
+        """Locked sum of a histogram's observation counts across labels."""
+        with self._lock:
+            h = self._histograms.get(name)
+            return sum(h._totals.values()) if h is not None else 0
+
+    def hist_snapshot(self, name: str):
+        """Locked copy of a histogram's (counts, totals) — the 'before' side
+        of delta_quantile (SLO windows scoped to one phase, the way the
+        density suite scopes its latency asserts)."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return ({k: list(v) for k, v in h._counts.items()},
+                    dict(h._totals))
+
+    def delta_quantile(self, name: str, snap, q: float, **labels) -> float:
+        """Quantile over observations made AFTER the snapshot (upper bound
+        of the bucket containing the q-th observation)."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                return 0.0
+            before_counts, before_totals = snap
+            k = _label_key(labels)
+            zero = [0] * (len(h.buckets) + 1)
+            counts = [a - b for a, b in zip(h._counts.get(k, zero),
+                                            before_counts.get(k, zero))]
+            total = h._totals.get(k, 0) - before_totals.get(k, 0)
+        if total <= 0:
+            return 0.0
+        seen, target = 0, q * total
+        for i, c in enumerate(counts[:-1]):
+            seen += c
+            if seen >= target:
+                return h.buckets[i]
+        return float("inf")
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         out = []

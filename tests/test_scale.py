@@ -29,32 +29,6 @@ N_NODES = int(os.environ.get("SCALE_NODES", 1000))
 N_PODS = int(os.environ.get("SCALE_PODS", 30000))
 
 
-def hist_snapshot(h):
-    return ({k: list(v) for k, v in h._counts.items()}, dict(h._totals))
-
-
-def delta_quantile(h, snap, q, **labels):
-    """Quantile over observations made AFTER the snapshot — the SLO window
-    is the scheduling phase, not the load-generator's own create burst
-    (the density suite asserts latency during paced operation)."""
-    from kubernetes_tpu.utils.metrics import _label_key
-    before_counts, before_totals = snap
-    k = _label_key(labels)
-    zero = [0] * (len(h.buckets) + 1)
-    counts = [a - b for a, b in zip(h._counts.get(k, zero),
-                                    before_counts.get(k, zero))]
-    total = h._totals.get(k, 0) - before_totals.get(k, 0)
-    if total <= 0:
-        return 0.0
-    target = q * total
-    seen = 0
-    for i, c in enumerate(counts[:-1]):
-        seen += c
-        if seen >= target:
-            return h.buckets[i]
-    return float("inf")
-
-
 def mk_node(i):
     # reference shape: 4 CPU / 32Gi / 110-pod cap (util.go:85-111)
     return api.Node(
@@ -102,16 +76,16 @@ class TestSchedule30KPods1KNodes:
             assert len(factory.node_lister.list()) == N_NODES
 
             sched = factory.create_batch_from_provider(batch_size=4096)
-            hist = METRICS.histogram("scheduler_e2e_scheduling_latency_seconds")
-            base = sum(hist._totals.values())
-            api_hist = METRICS.histogram("apiserver_request_seconds")
-            api_snap = hist_snapshot(api_hist)
+            E2E = "scheduler_e2e_scheduling_latency_seconds"
+            API = "apiserver_request_seconds"
+            base = METRICS.hist_total(E2E)
+            api_snap = METRICS.hist_snapshot(API)
             t0 = time.perf_counter()
             sched.run()
             deadline = time.monotonic() + 300
             bound = 0
             while time.monotonic() < deadline:
-                bound = sum(hist._totals.values()) - base
+                bound = METRICS.hist_total(E2E) - base
                 if bound >= N_PODS:
                     break
                 time.sleep(0.05)
@@ -125,7 +99,7 @@ class TestSchedule30KPods1KNodes:
             assert rate >= 8.0, f"{rate:.1f} pods/s under the 8 pods/s SLO"
             # API p99 <= 1s for >500-node clusters (metrics_util.go:46-49);
             # labeled per verb over the scheduling window, worst verb counts
-            p99 = max(delta_quantile(api_hist, api_snap, 0.99, verb=v)
+            p99 = max(METRICS.delta_quantile(API, api_snap, 0.99, verb=v)
                       for v in ("GET", "POST", "PUT", "DELETE"))
             assert 0 < p99 <= 1.0, f"API p99 {p99:.3f}s busts the 1s SLO"
             assert sched.kernel_failures == 0 and sched.health == "ok", (
