@@ -9,8 +9,10 @@ registerResourceHandlers):
   POST   /api/v1/namespaces/{ns}/{resource}
   GET    /api/v1/namespaces/{ns}/{resource}/{name}
   PUT    /api/v1/namespaces/{ns}/{resource}/{name}
+  PATCH  /api/v1/namespaces/{ns}/{resource}/{name}   (strategic / merge)
   DELETE /api/v1/namespaces/{ns}/{resource}/{name}
   PUT    /api/v1/namespaces/{ns}/pods/{name}/status
+  PATCH  /api/v1/namespaces/{ns}/pods/{name}/status
   POST   /api/v1/namespaces/{ns}/bindings         (+ pods/{name}/binding)
   GET    /healthz, /version, /metrics
 
@@ -131,9 +133,15 @@ class APIServer:
         class Handler(_Handler):
             pass
 
+        class Server(ThreadingHTTPServer):
+            # many clients open connections in the same instant (informer
+            # fan-out, burst creates); the http.server default backlog of 5
+            # RSTs the overflow
+            request_queue_size = 128
+
         Handler.registry = registry
         Handler.server_ref = outer
-        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd = Server((self._host, self._port), Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="apiserver", daemon=True)
@@ -391,6 +399,8 @@ class _Handler(BaseHTTPRequestHandler):
             if sub == "status":
                 return self._send_obj(self.registry.update_status(resource, obj, ns))
             return self._send_obj(self.registry.update(resource, obj, namespace=ns))
+        if method == "PATCH" and name:
+            return self._serve_patch(resource, name, ns, sub)
         if method == "DELETE" and name:
             self._admit("DELETE", resource, ns, name=name)
             return self._send_obj(self.registry.delete(resource, name, ns))
@@ -449,7 +459,7 @@ class _Handler(BaseHTTPRequestHandler):
             verb = ("watch" if q.get("watch") in ("true", "1")
                     else ("get" if name else "list"))
         else:
-            verb = {"POST": "create", "PUT": "update",
+            verb = {"POST": "create", "PUT": "update", "PATCH": "patch",
                     "DELETE": "delete"}.get(method, method.lower())
         attrs = AuthzAttributes(user=self._user, verb=verb, resource=resource,
                                 subresource=subresource, namespace=ns,
@@ -547,6 +557,74 @@ class _Handler(BaseHTTPRequestHandler):
             "metadata": {"resourceVersion": str(rv)},
             "items": [codec.encode_item(o) for o in items],
         })
+
+    # patch content types (reference api.StrategicMergePatchType /
+    # MergePatchType, resthandler.go:503-615)
+    STRATEGIC_PATCH = "application/strategic-merge-patch+json"
+    MERGE_PATCH = "application/merge-patch+json"
+
+    def _serve_patch(self, resource, name, ns, sub):
+        """Server-side PATCH: read-modify-write under optimistic concurrency.
+
+        The merged object carries the read's resourceVersion, so a
+        concurrent writer between our GET and UPDATE surfaces as 409 and we
+        re-get + re-apply — the reference's patchResource retry
+        (resthandler.go:562-615). This is what lets concurrent label and
+        status patches of one pod both land without a lost update."""
+        from kubernetes_tpu.utils.strategicpatch import (
+            apply_patch, json_merge_patch,
+        )
+        if sub not in ("", None, "status"):
+            self._read_body()  # drain: keep-alive must not desync
+            return self._send_status(
+                405, "MethodNotAllowed", f"PATCH not supported on {sub}")
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype in (self.STRATEGIC_PATCH, "", "application/json",
+                     binary_codec.CONTENT_TYPE):
+            merge = apply_patch
+        elif ctype == self.MERGE_PATCH:
+            merge = json_merge_patch
+        else:
+            self._read_body()  # drain: keep-alive must not desync
+            return self._send_status(
+                415, "UnsupportedMediaType",
+                f"unsupported patch type {ctype!r}; use "
+                f"{self.STRATEGIC_PATCH} or {self.MERGE_PATCH}")
+        patch = self._read_body()
+        if not isinstance(patch, dict):
+            raise bad_request(
+                f"patch body must be a JSON object, got {type(patch).__name__}")
+        if "resourceVersion" in (patch.get("metadata") or {}):
+            raise bad_request("metadata.resourceVersion may not be patched")
+        rd = RESOURCES[resource]
+        codec = getattr(self, "_codec", _V1CODEC)
+        last = None
+        for attempt in range(50):
+            if attempt:
+                # jittered backoff: N racing patchers otherwise re-collide
+                # in lockstep and exhaust any fixed retry budget
+                import random
+                import time as _time
+                _time.sleep(random.uniform(0, 0.002 * min(attempt, 10)))
+            current = self.registry.get(resource, name, ns)
+            merged = merge(codec.encode(current), patch)
+            obj = codec.decode_into(rd.cls, merged)
+            self._check_body_matches_url(obj, name, ns)
+            # CAS token: the patch applies to the state we read
+            obj.metadata.resource_version = current.metadata.resource_version
+            if not sub:
+                self._admit("UPDATE", resource, ns, name=name, obj=obj)
+            try:
+                if sub == "status":
+                    return self._send_obj(
+                        self.registry.update_status(resource, obj, ns))
+                return self._send_obj(
+                    self.registry.update(resource, obj, namespace=ns))
+            except RegistryError as e:
+                if e.code != 409:
+                    raise
+                last = e
+        raise last
 
     def _serve_binding(self, ns, pod_name: Optional[str] = None):
         body = self._read_body()
@@ -665,6 +743,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         self._route("PUT")
+
+    def do_PATCH(self):
+        self._route("PATCH")
 
     def do_DELETE(self):
         self._route("DELETE")

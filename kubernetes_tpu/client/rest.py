@@ -167,12 +167,14 @@ class RESTClient:
                 pass
             self._local.conn = None
 
-    def request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+    def request(self, method: str, path: str, body: Optional[dict] = None,
+                content_type: Optional[str] = None) -> dict:
         # 429 = server-side max-in-flight shed the request before executing
         # it: always safe to retry after a short backoff (the reference
         # client honors Retry-After the same way)
         for backoff in (0.1, 0.4, 1.0, 2.0, None):
-            parsed = self._request_once(method, path, body)
+            parsed = self._request_once(method, path, body,
+                                        content_type=content_type)
             if parsed.get("code") == 429 and backoff is not None:
                 import time as _time
                 _time.sleep(backoff)
@@ -184,20 +186,23 @@ class RESTClient:
         raise AssertionError("unreachable")
 
     def _request_once(self, method: str, path: str,
-                      body: Optional[dict] = None) -> dict:
+                      body: Optional[dict] = None,
+                      content_type: Optional[str] = None) -> dict:
         self._limiter.accept()
-        binary = self.content_type == binary_codec.CONTENT_TYPE
+        binary = (self.content_type == binary_codec.CONTENT_TYPE
+                  and content_type is None)
         if body is None:
             payload = None
         elif binary:
             payload = binary_codec.encode_dict(body)
         else:
+            # explicit content types (patches) always travel as JSON
             payload = json.dumps(body).encode()
         headers = {"User-Agent": self.user_agent}
-        if binary:
+        if self.content_type == binary_codec.CONTENT_TYPE:
             headers["Accept"] = binary_codec.CONTENT_TYPE
         if payload is not None:
-            headers["Content-Type"] = self.content_type
+            headers["Content-Type"] = content_type or self.content_type
         self._auth_headers(headers)
         for attempt in (1, 2):
             conn = self._conn()
@@ -308,6 +313,27 @@ class RESTClient:
                          self._item_path(resource, obj.metadata.name, ns) + "/status",
                          scheme.encode(obj))
         return from_dict(RESOURCES[resource].cls, d)
+
+    # patch content types (reference pkg/api/types.go PatchType)
+    STRATEGIC_PATCH = "application/strategic-merge-patch+json"
+    MERGE_PATCH = "application/merge-patch+json"
+
+    def patch(self, resource: str, name: str, patch: dict, namespace: str = "",
+              subresource: str = "", patch_type: str = STRATEGIC_PATCH):
+        """Server-side PATCH (resthandler.go:503-615): the server merges and
+        retries conflicts, so concurrent writers of disjoint fields — label
+        PATCH vs status PATCH — both land without a read-modify-write race
+        on the client."""
+        path = self._item_path(resource, name, namespace)
+        if subresource:
+            path += f"/{subresource}"
+        d = self.request("PATCH", path, patch, content_type=patch_type)
+        return from_dict(RESOURCES[resource].cls, d)
+
+    def patch_status(self, resource: str, name: str, patch: dict,
+                     namespace: str = ""):
+        return self.patch(resource, name, patch, namespace,
+                          subresource="status")
 
     def delete(self, resource: str, name: str, namespace: str = ""):
         d = self.request("DELETE", self._item_path(resource, name, namespace))

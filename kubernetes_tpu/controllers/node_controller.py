@@ -118,6 +118,11 @@ class NodeController:
             fresh.status = api.NodeStatus()
         fresh.status.conditions = conds
         try:
+            # deliberately a resourceVersion-checked PUT, not a PATCH: the
+            # Ready=Unknown flip is only valid against the exact heartbeat
+            # state the controller judged stale — a server-retried PATCH
+            # would clobber a fresh kubelet heartbeat that landed in between,
+            # while the CAS update 409s (swallowed; re-judged next tick)
             self.client.update_status("nodes", fresh)
         except ApiError:
             pass

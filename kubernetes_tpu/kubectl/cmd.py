@@ -196,19 +196,19 @@ def cmd_apply(args) -> int:
             client.create(resource, obj, ns)
             print(f"{RESOURCES[resource].kind.lower()} \"{name}\" created")
             continue
-        live_dict = scheme.encode(live)
         original = json.loads(
             (live.metadata.annotations or {}).get(ANN_LAST_APPLIED, "{}"))
-        merged = strategicpatch.three_way_merge(original, raw, live_dict)
-        md = merged.setdefault("metadata", {})
-        md.setdefault("annotations", {})
-        if md["annotations"] is None:
-            md["annotations"] = {}
-        md["annotations"][ANN_LAST_APPLIED] = modified
-        # carry the live resourceVersion for optimistic concurrency
-        md["resourceVersion"] = live.metadata.resource_version
-        merged_obj = scheme.decode_into(RESOURCES[resource].cls, merged)
-        client.update(resource, merged_obj, ns)
+        # send the two-way (original->desired) strategic patch and let the
+        # SERVER merge it onto live under optimistic concurrency
+        # (resthandler.go:503-615) — apply no longer races other writers
+        # between its GET and write
+        patch = strategicpatch.create_two_way_merge_patch(original, raw)
+        md = patch.setdefault("metadata", {}) or {}
+        patch["metadata"] = md
+        ann = md.setdefault("annotations", {}) or {}
+        md["annotations"] = ann
+        ann[ANN_LAST_APPLIED] = modified
+        client.patch(resource, name, patch, ns)
         print(f"{RESOURCES[resource].kind.lower()} \"{name}\" configured")
     return 0
 
@@ -359,11 +359,12 @@ def _mutate_map(client, args, which: str) -> int:
                 raise CommandError(
                     f"'{k}' already has a value ({cur[k]}), and "
                     f"--overwrite is false")
-        cur.update(sets)
-        for k in removes:
-            cur.pop(k, None)
-        setattr(obj.metadata, which, cur or None)
-        client.update(resource, obj, ns)
+        # PATCH just the touched keys (None deletes under strategic merge) —
+        # the GET above is only the --overwrite guard, not a write base, so
+        # concurrent writers of other fields can't be clobbered
+        delta = dict(sets)
+        delta.update({k: None for k in removes})
+        client.patch(resource, name, {"metadata": {which: delta}}, ns)
         print(f"{RESOURCES[resource].kind.lower()} \"{name}\" labeled"
               if which == "labels" else
               f"{RESOURCES[resource].kind.lower()} \"{name}\" annotated")
@@ -381,11 +382,7 @@ def cmd_annotate(args) -> int:
 # --- node ops: cordon / uncordon / drain --------------------------------------
 
 def _set_unschedulable(client, name: str, value: bool) -> None:
-    node = client.get("nodes", name)
-    if node.spec is None:
-        node.spec = api.NodeSpec()
-    node.spec.unschedulable = value
-    client.update("nodes", node)
+    client.patch("nodes", name, {"spec": {"unschedulable": value}})
 
 
 def cmd_cordon(args) -> int:

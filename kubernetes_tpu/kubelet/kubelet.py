@@ -16,7 +16,7 @@ from typing import Dict, Optional
 
 from kubernetes_tpu.api import fields as fieldsel
 from kubernetes_tpu.api import types as api
-from kubernetes_tpu.api.serialization import deep_copy
+from kubernetes_tpu.api.serialization import deep_copy, scheme
 from kubernetes_tpu.client import Informer, ListWatch, RESTClient
 from kubernetes_tpu.client.record import EventRecorder
 from kubernetes_tpu.client.rest import ApiError
@@ -128,7 +128,18 @@ class Kubelet:
             last_heartbeat_time=now_iso()))
         node.status.conditions = conds
         try:
-            self.client.update_status("nodes", node)
+            # status PATCH, not PUT: concurrent spec writers (cordon, taints)
+            # can no longer be clobbered by a stale heartbeat read
+            # (reference resthandler.go:503 PATCH; merge type replaces the
+            # conditions list wholesale, which the heartbeat owns)
+            enc = scheme.encode(node)
+            status = {k: enc["status"].get(k)
+                      for k in ("conditions", "allocatable", "capacity")
+                      if enc["status"].get(k) is not None}
+            self.client.patch(
+                "nodes", node.metadata.name, {"status": status},
+                subresource="status",
+                patch_type=self.client.MERGE_PATCH)
         except ApiError:
             pass
 
@@ -234,7 +245,13 @@ class Kubelet:
                     for c, cid in zip(fresh.spec.containers or [],
                                       running.container_ids)]
         try:
-            self.client.update_status("pods", fresh)
+            # status PATCH (merge type): only the fields this kubelet
+            # composes travel; fields owned by other writers survive
+            self.client.patch(
+                "pods", fresh.metadata.name,
+                {"status": scheme.encode(fresh).get("status", {})},
+                namespace=fresh.metadata.namespace, subresource="status",
+                patch_type=self.client.MERGE_PATCH)
             self._statuses[key] = sig
             self._pending_terminal.pop(key, None)
         except ApiError as e:

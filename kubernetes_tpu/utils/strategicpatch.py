@@ -88,8 +88,24 @@ def apply_patch(current: Dict, patch: Dict) -> Dict:
                 _mergeable(k, cv + pv):
             out[k] = _merge_lists(cv, pv, _merge_key_for(k))
         else:
-            out[k] = copy.deepcopy(pv)
+            # target key absent (or scalar): the patch subtree becomes the
+            # value, minus its deletion directives — a {k: null} delete of a
+            # key inside an absent map must not store a literal null, and a
+            # $patch:delete element must not survive as data
+            out[k] = _strip_directives(pv)
     return out
+
+
+def _strip_directives(v):
+    """Deep-copy a patch subtree with deletion directives executed against
+    nothing: null map values drop, $patch-delete list elements drop."""
+    if isinstance(v, dict):
+        return {k: _strip_directives(sv) for k, sv in v.items()
+                if sv is not None}
+    if isinstance(v, list):
+        return [_strip_directives(e) for e in v
+                if not (isinstance(e, dict) and e.get("$patch") == "delete")]
+    return copy.deepcopy(v)
 
 
 def three_way_merge(original: Dict, modified: Dict, current: Dict) -> Dict:
@@ -97,6 +113,21 @@ def three_way_merge(original: Dict, modified: Dict, current: Dict) -> Dict:
     top of current, preserving fields others set on current."""
     patch = create_two_way_merge_patch(original, modified)
     return apply_patch(current, patch)
+
+
+def json_merge_patch(target, patch):
+    """RFC 7386 merge patch (reference application/merge-patch+json,
+    resthandler.go:503 JSONPatchType switch): recursive map merge, null
+    deletes, everything else — including lists — replaces wholesale."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    out = dict(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = json_merge_patch(out.get(k), v)
+    return out
 
 
 def _merge_key_for(field: str) -> Optional[str]:

@@ -1,0 +1,243 @@
+"""Server-side PATCH: strategic merge + RFC-7386 merge, conflict retry.
+
+Parity target: reference pkg/apiserver/resthandler.go:503-615 (PATCH verb
+with three content types and in-server conflict retry) and
+pkg/util/strategicpatch/patch.go (merge semantics). The headline property
+(round-4 verdict #6): concurrent writers of disjoint fields — a label PATCH
+and a status PATCH of one pod — must BOTH land, no lost update.
+"""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.client.rest import ApiError
+
+
+def mk_pod(name="p0", ns="default", labels=None):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(containers=[
+            api.Container(name="main", image="img:1"),
+            api.Container(name="side", image="side:1")]))
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    try:
+        yield s
+    finally:
+        s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=1000, burst=1000)
+
+
+class TestStrategicPatch:
+    def test_label_merge_and_delete(self, client):
+        client.create("pods", mk_pod(labels={"a": "1", "b": "2"}))
+        got = client.patch("pods", "p0",
+                           {"metadata": {"labels": {"b": None, "c": "3"}}},
+                           "default")
+        assert got.metadata.labels == {"a": "1", "c": "3"}
+        # and it persisted
+        assert client.get("pods", "p0", "default").metadata.labels == {
+            "a": "1", "c": "3"}
+
+    def test_container_list_merges_by_name(self, client):
+        client.create("pods", mk_pod())
+        got = client.patch(
+            "pods", "p0",
+            {"spec": {"containers": [{"name": "main", "image": "img:2"}]}},
+            "default")
+        by_name = {c.name: c.image for c in got.spec.containers}
+        # the named element updated; the sibling survived (merge-by-key,
+        # not wholesale replace)
+        assert by_name == {"main": "img:2", "side": "side:1"}
+
+    def test_dollar_patch_delete_removes_element(self, client):
+        client.create("pods", mk_pod())
+        got = client.patch(
+            "pods", "p0",
+            {"spec": {"containers": [{"name": "side", "$patch": "delete"}]}},
+            "default")
+        assert [c.name for c in got.spec.containers] == ["main"]
+
+    def test_status_subresource_patch(self, client):
+        client.create("pods", mk_pod())
+        got = client.patch_status("pods", "p0",
+                                  {"status": {"phase": "Running"}}, "default")
+        assert got.status.phase == "Running"
+        # main-resource fields unchanged by a status patch
+        assert got.spec.containers[0].image == "img:1"
+
+    def test_resource_version_not_patchable(self, client):
+        client.create("pods", mk_pod())
+        with pytest.raises(ApiError) as ei:
+            client.patch("pods", "p0",
+                         {"metadata": {"resourceVersion": "1"}}, "default")
+        assert ei.value.code == 400
+
+    def test_unknown_patch_type_415(self, client):
+        client.create("pods", mk_pod())
+        with pytest.raises(ApiError) as ei:
+            client.patch("pods", "p0", {"metadata": {}}, "default",
+                         patch_type="application/json-patch+json")
+        assert ei.value.code == 415
+
+    def test_patch_missing_object_404(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.patch("pods", "ghost", {"metadata": {}}, "default")
+        assert ei.value.code == 404
+
+    def test_delete_directive_on_absent_map_is_noop(self, client):
+        """{k: null} aimed at a map the object doesn't have must not store
+        a literal null (label selectors would then see a None-valued key)."""
+        client.create("pods", mk_pod(labels=None))
+        got = client.patch("pods", "p0",
+                           {"metadata": {"labels": {"gone": None, "a": "1"}}},
+                           "default")
+        assert got.metadata.labels == {"a": "1"}
+
+    def test_non_dict_body_400(self, client):
+        client.create("pods", mk_pod())
+        with pytest.raises(ApiError) as ei:
+            client.request("PATCH", "/api/v1/namespaces/default/pods/p0",
+                           ["not", "an", "object"],
+                           content_type=RESTClient.STRATEGIC_PATCH)
+        assert ei.value.code == 400
+
+    def test_patch_on_binding_subresource_405(self, client):
+        client.create("pods", mk_pod())
+        with pytest.raises(ApiError) as ei:
+            client.request("PATCH",
+                           "/api/v1/namespaces/default/pods/p0/binding",
+                           {"spec": {"nodeName": "sneaky"}},
+                           content_type=RESTClient.STRATEGIC_PATCH)
+        assert ei.value.code == 405
+        # and the main resource is untouched
+        assert client.get("pods", "p0", "default").spec.node_name in (None, "")
+
+    def test_415_keeps_connection_usable(self, client):
+        """The 415 path must drain the unread body or the next request on
+        the same keep-alive connection parses garbage."""
+        client.create("pods", mk_pod())
+        for _ in range(3):
+            with pytest.raises(ApiError) as ei:
+                client.patch("pods", "p0", {"metadata": {"labels": {"x": "1"}}},
+                             "default", patch_type="application/json-patch+json")
+            assert ei.value.code == 415
+            # same-thread connection reused for a normal request
+            assert client.get("pods", "p0", "default").metadata.name == "p0"
+
+
+class TestMergePatch:
+    def test_lists_replace_wholesale(self, client):
+        client.create("pods", mk_pod())
+        got = client.patch(
+            "pods", "p0",
+            {"spec": {"containers": [{"name": "only", "image": "o:1"}]}},
+            "default", patch_type=RESTClient.MERGE_PATCH)
+        assert [c.name for c in got.spec.containers] == ["only"]
+
+    def test_null_deletes_key(self, client):
+        client.create("pods", mk_pod(labels={"a": "1"}))
+        got = client.patch("pods", "p0", {"metadata": {"labels": None}},
+                           "default", patch_type=RESTClient.MERGE_PATCH)
+        assert not got.metadata.labels
+
+
+class TestConcurrentPatchers:
+    def test_label_and_status_patches_both_land(self, client):
+        """The lost-update surface PATCH exists to shrink: N writers on
+        disjoint fields of one object, zero coordination, all must land."""
+        client.create("pods", mk_pod())
+        n = 16
+        errs = []
+        barrier = threading.Barrier(n * 2)
+
+        def label_writer(i):
+            try:
+                barrier.wait()
+                client.patch("pods", "p0",
+                             {"metadata": {"labels": {f"k{i}": str(i)}}},
+                             "default")
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        def status_writer(i):
+            try:
+                barrier.wait()
+                client.patch_status(
+                    "pods", "p0",
+                    {"status": {"phase": "Running",
+                                "message": f"writer-{i}"}}, "default")
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = ([threading.Thread(target=label_writer, args=(i,))
+                    for i in range(n)]
+                   + [threading.Thread(target=status_writer, args=(i,))
+                      for i in range(n)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        final = client.get("pods", "p0", "default")
+        # EVERY label writer's key landed (no lost update) and status landed
+        assert {f"k{i}": str(i) for i in range(n)}.items() <= (
+            final.metadata.labels or {}).items()
+        assert final.status.phase == "Running"
+
+    def test_patch_vs_put_conflict_retry(self, client):
+        """A PUT racing the server's get->merge->update window forces 409s;
+        the server re-gets and re-applies (resthandler.go:562-615)."""
+        client.create("pods", mk_pod(labels={"seed": "y"}))
+        stop = threading.Event()
+
+        def put_hammer():
+            while not stop.is_set():
+                try:
+                    obj = client.get("pods", "p0", "default")
+                    obj.metadata.labels = dict(obj.metadata.labels or {},
+                                               put="1")
+                    client.update("pods", obj)
+                except ApiError:
+                    pass  # the PUT side may conflict; that's its problem
+
+        th = threading.Thread(target=put_hammer)
+        th.start()
+        try:
+            for i in range(25):
+                client.patch("pods", "p0",
+                             {"metadata": {"labels": {f"p{i}": "1"}}},
+                             "default")
+        finally:
+            stop.set()
+            th.join()
+        final = client.get("pods", "p0", "default")
+        assert {f"p{i}" for i in range(25)} <= set(final.metadata.labels)
+
+
+class TestKubectlOverPatch:
+    def test_label_annotate_cordon_use_patch(self, server, client, capsys):
+        from kubernetes_tpu.kubectl.cmd import main as kubectl
+        client.create("pods", mk_pod(labels={"keep": "1"}))
+        client.create(
+            "nodes", api.Node(metadata=api.ObjectMeta(name="n0"),
+                              spec=api.NodeSpec()))
+        host = ["-s", f"127.0.0.1:{server.port}"]
+        assert kubectl(host + ["label", "pods", "p0", "x=1"]) == 0
+        assert kubectl(host + ["annotate", "pods", "p0", "note=hi"]) == 0
+        assert kubectl(host + ["cordon", "n0"]) == 0
+        pod = client.get("pods", "p0", "default")
+        assert pod.metadata.labels == {"keep": "1", "x": "1"}
+        assert (pod.metadata.annotations or {}).get("note") == "hi"
+        assert client.get("nodes", "n0").spec.unschedulable is True
