@@ -560,8 +560,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     # patch content types (reference api.StrategicMergePatchType /
     # MergePatchType, resthandler.go:503-615)
-    STRATEGIC_PATCH = "application/strategic-merge-patch+json"
-    MERGE_PATCH = "application/merge-patch+json"
+    from kubernetes_tpu.utils.strategicpatch import (
+        MERGE_PATCH_TYPE as MERGE_PATCH,
+        STRATEGIC_PATCH_TYPE as STRATEGIC_PATCH,
+    )
 
     def _serve_patch(self, resource, name, ns, sub):
         """Server-side PATCH: read-modify-write under optimistic concurrency.

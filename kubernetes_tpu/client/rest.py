@@ -315,8 +315,10 @@ class RESTClient:
         return from_dict(RESOURCES[resource].cls, d)
 
     # patch content types (reference pkg/api/types.go PatchType)
-    STRATEGIC_PATCH = "application/strategic-merge-patch+json"
-    MERGE_PATCH = "application/merge-patch+json"
+    from kubernetes_tpu.utils.strategicpatch import (
+        MERGE_PATCH_TYPE as MERGE_PATCH,
+        STRATEGIC_PATCH_TYPE as STRATEGIC_PATCH,
+    )
 
     def patch(self, resource: str, name: str, patch: dict, namespace: str = "",
               subresource: str = "", patch_type: str = STRATEGIC_PATCH):

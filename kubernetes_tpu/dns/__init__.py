@@ -1,0 +1,11 @@
+"""Cluster DNS (kube-dns analog).
+
+Parity target: reference cmd/kube-dns/dns.go — skydns backed by the
+service/endpoints watch. Here the record table is computed straight off the
+service + endpoints informer stores and served by a small RFC-1035 UDP
+responder; no external DNS library, no intermediate etcd.
+"""
+
+from kubernetes_tpu.dns.server import DNSServer, encode_query, decode_response
+
+__all__ = ["DNSServer", "encode_query", "decode_response"]

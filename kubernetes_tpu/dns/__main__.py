@@ -1,0 +1,45 @@
+"""kube-dns entrypoint (reference cmd/kube-dns/dns.go flag surface subset)."""
+
+import argparse
+import logging
+import signal
+import threading
+
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.dns.server import DNSServer
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("kube-dns")
+    ap.add_argument("--kube-master", default="127.0.0.1:8080",
+                    help="host:port of the API server")
+    ap.add_argument("--dns-port", type=int, default=10053)
+    ap.add_argument("--dns-bind", default="127.0.0.1")
+    ap.add_argument("--domain", default="cluster.local")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    master = args.kube_master
+    if "//" in master:
+        master = master.split("//", 1)[1]
+    host, _, port = master.rstrip("/").partition(":")
+    client = RESTClient(host=host, port=int(port or 8080))
+    server = DNSServer(client, domain=args.domain, port=args.dns_port,
+                       host=args.dns_bind).start()
+    # parseable banner on stdout (localup reads it to learn the bound
+    # port when started with --dns-port 0, like the apiserver's banner)
+    print(f"dns listening on {args.dns_bind}:{server.port}", flush=True)
+    logging.info("kube-dns serving %s on %s:%d", args.domain, args.dns_bind,
+                 server.port)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    try:
+        done.wait()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,389 @@
+"""Cluster DNS over the service/endpoints informers.
+
+Record forms (reference cmd/kube-dns/dns.go; skydns path conventions):
+
+  {svc}.{ns}.svc.{domain}                  A -> clusterIP, or one A per
+                                                ready endpoint address when
+                                                the service is headless
+                                                (clusterIP == "None")
+  {host}.{svc}.{ns}.svc.{domain}           A -> that endpoint (headless):
+                                                `host` is the address
+                                                hostname (target pod name)
+                                                or the dashed IP (10-0-0-3)
+  _{port}._{proto}.{svc}.{ns}.svc.{domain} SRV -> service port; one record
+                                                per endpoint for headless
+  {reversed}.in-addr.arpa                  PTR -> {svc}.{ns}.svc.{domain}
+                                                for allocated cluster IPs
+
+Nonexistent names inside the cluster domain answer NXDOMAIN; names outside
+it REFUSED (this server is authoritative only — no recursion, matching the
+reference's skydns `no_rec` deployment mode). AAAA for an existing name
+answers NOERROR with zero answers so v6-preferring resolvers fall through
+to A.
+
+The UDP responder is a single thread on a datagram socket; each query is
+answered from the informer stores' current state — no record cache to
+invalidate, the watch IS the cache coherence protocol.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+
+log = logging.getLogger("kubedns")
+
+# qtypes
+TYPE_A = 1
+TYPE_PTR = 12
+TYPE_AAAA = 28
+TYPE_SRV = 33
+TYPE_ANY = 255
+CLASS_IN = 1
+
+# rcodes
+RCODE_OK = 0
+RCODE_NXDOMAIN = 3
+RCODE_REFUSED = 5
+
+
+# --- wire codec (RFC 1035) ----------------------------------------------------
+
+def _encode_name(name: str) -> bytes:
+    out = b""
+    for label in name.rstrip(".").split("."):
+        raw = label.encode("ascii")
+        if not 0 < len(raw) < 64:
+            raise ValueError(f"bad label {label!r}")
+        out += bytes([len(raw)]) + raw
+    return out + b"\x00"
+
+
+def _read_name(buf: bytes, off: int) -> Tuple[str, int]:
+    """Decode a (possibly compressed) name; returns (name, next offset)."""
+    labels: List[str] = []
+    jumped = False
+    end = off
+    hops = 0
+    while True:
+        if off >= len(buf):
+            raise ValueError("truncated name")
+        ln = buf[off]
+        if ln & 0xC0 == 0xC0:  # compression pointer
+            if off + 1 >= len(buf):
+                raise ValueError("truncated pointer")
+            ptr = ((ln & 0x3F) << 8) | buf[off + 1]
+            if not jumped:
+                end = off + 2
+            off = ptr
+            jumped = True
+            hops += 1
+            if hops > 32:
+                raise ValueError("pointer loop")
+            continue
+        off += 1
+        if ln == 0:
+            if not jumped:
+                end = off
+            break
+        labels.append(buf[off:off + ln].decode("ascii", "replace"))
+        off += ln
+    return ".".join(labels), end
+
+
+def encode_query(qid: int, name: str, qtype: int) -> bytes:
+    """Client-side query encoder (used by tests and the resolver helper)."""
+    hdr = struct.pack(">HHHHHH", qid, 0x0100, 1, 0, 0, 0)  # RD set
+    return hdr + _encode_name(name) + struct.pack(">HH", qtype, CLASS_IN)
+
+
+def decode_response(data: bytes) -> dict:
+    """Minimal response decoder: {'id', 'rcode', 'answers': [(name, type,
+    rdata)]} where rdata is a dotted IP for A, a name for PTR, and
+    (prio, weight, port, target) for SRV."""
+    qid, flags, qd, an, _, _ = struct.unpack(">HHHHHH", data[:12])
+    off = 12
+    for _ in range(qd):
+        _, off = _read_name(data, off)
+        off += 4
+    answers = []
+    for _ in range(an):
+        name, off = _read_name(data, off)
+        rtype, _, _, rdlen = struct.unpack(">HHIH", data[off:off + 10])
+        off += 10
+        rdata = data[off:off + rdlen]
+        if rtype == TYPE_A:
+            answers.append((name, rtype, socket.inet_ntoa(rdata)))
+        elif rtype == TYPE_PTR:
+            target, _ = _read_name(data, off)
+            answers.append((name, rtype, target))
+        elif rtype == TYPE_SRV:
+            prio, weight, port = struct.unpack(">HHH", rdata[:6])
+            target, _ = _read_name(data, off + 6)
+            answers.append((name, rtype, (prio, weight, port, target)))
+        else:
+            answers.append((name, rtype, rdata))
+        off += rdlen
+    return {"id": qid, "rcode": flags & 0xF, "answers": answers}
+
+
+def _rr(name: str, rtype: int, rdata: bytes, ttl: int = 30) -> bytes:
+    return (_encode_name(name) + struct.pack(">HHIH", rtype, CLASS_IN, ttl,
+                                             len(rdata)) + rdata)
+
+
+# --- the server ---------------------------------------------------------------
+
+class DNSServer:
+    """Authoritative DNS for `svc.{domain}` off the cluster watch."""
+
+    def __init__(self, client: Optional[RESTClient] = None,
+                 domain: str = "cluster.local", port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.domain = domain.strip(".")
+        self._host = host
+        self._port = port
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.svc_informer = self.ep_informer = None
+        if client is not None:
+            self.svc_informer = Informer(ListWatch(client, "services"))
+            self.ep_informer = Informer(ListWatch(client, "endpoints"))
+        # static tables for informer-less (unit) use
+        self._services: Dict[Tuple[str, str], api.Service] = {}
+        self._endpoints: Dict[Tuple[str, str], api.Endpoints] = {}
+
+    # -- state feeding ---------------------------------------------------------
+
+    def set_static(self, services: List[api.Service],
+                   endpoints: List[api.Endpoints]) -> None:
+        self._services = {(s.metadata.namespace or "default",
+                           s.metadata.name): s for s in services}
+        self._endpoints = {(e.metadata.namespace or "default",
+                            e.metadata.name): e for e in endpoints}
+
+    def _service(self, ns: str, name: str) -> Optional[api.Service]:
+        if self.svc_informer is not None:
+            # keyed O(1) lookup (ThreadSafeStore ns/name keys) — the
+            # responder is single-threaded; per-packet linear scans would
+            # make DNS latency scale with cluster size
+            store = self.svc_informer.store
+            return store.get(f"{ns}/{name}") or store.get(name)
+        return self._services.get((ns, name))
+
+    def _eps(self, ns: str, name: str) -> Optional[api.Endpoints]:
+        if self.ep_informer is not None:
+            store = self.ep_informer.store
+            return store.get(f"{ns}/{name}") or store.get(name)
+        return self._endpoints.get((ns, name))
+
+    def _all_services(self):
+        if self.svc_informer is not None:
+            return list(self.svc_informer.store.list())
+        return list(self._services.values())
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        assert self._sock is not None, "server not started"
+        return self._sock.getsockname()[1]
+
+    def start(self) -> "DNSServer":
+        if self.svc_informer is not None:
+            self.svc_informer.run()
+            self.ep_informer.run()
+            self.svc_informer.wait_for_sync(30)
+            self.ep_informer.wait_for_sync(30)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((self._host, self._port))
+        self._sock.settimeout(0.5)
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name="kube-dns", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        for inf in (self.svc_informer, self.ep_informer):
+            if inf is not None:
+                inf.stop()
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, addr = self._sock.recvfrom(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                resp = self.handle(data)
+            except Exception:  # a bad packet must not kill the server
+                log.exception("dns: dropping malformed query")
+                continue
+            if resp is not None:
+                try:
+                    self._sock.sendto(resp, addr)
+                except OSError:
+                    pass
+
+    # -- resolution ------------------------------------------------------------
+
+    def handle(self, data: bytes) -> Optional[bytes]:
+        if len(data) < 12:
+            return None
+        qid, flags, qd, _, _, _ = struct.unpack(">HHHHHH", data[:12])
+        if flags & 0x8000 or qd < 1:  # response bit set / no question
+            return None
+        off = 12
+        qname, off = _read_name(data, off)
+        qtype, qclass = struct.unpack(">HH", data[off:off + 4])
+        question = (_encode_name(qname)
+                    + struct.pack(">HH", qtype, qclass))
+        if qclass != CLASS_IN:
+            return self._reply(qid, question, RCODE_REFUSED, [])
+        rcode, answers = self.resolve(qname.lower(), qtype)
+        return self._reply(qid, question, rcode, answers)
+
+    @staticmethod
+    def _reply(qid: int, question: bytes, rcode: int,
+               answers: List[bytes]) -> bytes:
+        flags = 0x8400 | rcode  # QR + AA
+        hdr = struct.pack(">HHHHHH", qid, flags, 1, len(answers), 0, 0)
+        return hdr + question + b"".join(answers)
+
+    def resolve(self, qname: str, qtype: int) -> Tuple[int, List[bytes]]:
+        """(rcode, encoded answer RRs) for one question."""
+        if qname.endswith(".in-addr.arpa"):
+            return self._resolve_ptr(qname, qtype)
+        suffix = f".svc.{self.domain}"
+        if not qname.endswith(suffix):
+            # not ours: REFUSED unless it's the bare domain
+            return ((RCODE_NXDOMAIN, []) if qname.endswith(self.domain)
+                    else (RCODE_REFUSED, []))
+        rel = qname[: -len(suffix)]
+        parts = rel.split(".")
+        if len(parts) == 2:
+            svc, eps = self._lookup(parts[1], parts[0])
+            if svc is None:
+                return RCODE_NXDOMAIN, []
+            if qtype in (TYPE_A, TYPE_ANY):
+                return RCODE_OK, self._a_records(qname, svc, eps)
+            return RCODE_OK, []  # AAAA etc on an existing name: empty NOERROR
+        if len(parts) == 3 and not parts[0].startswith("_"):
+            # {host}.{svc}.{ns}: headless per-endpoint record
+            svc, eps = self._lookup(parts[2], parts[1])
+            if svc is None or not _headless(svc):
+                return RCODE_NXDOMAIN, []
+            ips = [ip for host, ip in _endpoint_hosts(eps)
+                   if host == parts[0]]
+            if not ips:
+                return RCODE_NXDOMAIN, []
+            if qtype in (TYPE_A, TYPE_ANY):
+                return RCODE_OK, [
+                    _rr(qname, TYPE_A, socket.inet_aton(ip)) for ip in ips]
+            return RCODE_OK, []
+        if len(parts) == 4 and parts[0].startswith("_") \
+                and parts[1].startswith("_"):
+            return self._resolve_srv(qname, parts)
+        return RCODE_NXDOMAIN, []
+
+    def _lookup(self, ns: str, name: str):
+        svc = self._service(ns, name)
+        eps = self._eps(ns, name) if svc is not None else None
+        return svc, eps
+
+    def _a_records(self, qname: str, svc: api.Service,
+                   eps: Optional[api.Endpoints]) -> List[bytes]:
+        if _headless(svc):
+            return [_rr(qname, TYPE_A, socket.inet_aton(ip))
+                    for _, ip in _endpoint_hosts(eps)]
+        ip = svc.spec.cluster_ip if svc.spec else ""
+        if not ip or ip == "None":
+            return []
+        return [_rr(qname, TYPE_A, socket.inet_aton(ip))]
+
+    def _resolve_srv(self, qname: str, parts: List[str]):
+        portname, proto = parts[0][1:], parts[1][1:]
+        svc, eps = self._lookup(parts[3], parts[2])
+        if svc is None or svc.spec is None:
+            return RCODE_NXDOMAIN, []
+        matching = [p for p in (svc.spec.ports or [])
+                    if (p.protocol or "TCP").lower() == proto
+                    and (p.name or "") == portname]
+        if not matching:
+            return RCODE_NXDOMAIN, []
+        svc_name = f"{svc.metadata.name}.{svc.metadata.namespace or 'default'}" \
+                   f".svc.{self.domain}"
+        out = []
+        for p in matching:
+            if _headless(svc):
+                for host, _ in _endpoint_hosts(eps):
+                    target = f"{host}.{svc_name}"
+                    out.append(_rr(qname, TYPE_SRV,
+                                   struct.pack(">HHH", 10, 10, p.port)
+                                   + _encode_name(target)))
+            else:
+                out.append(_rr(qname, TYPE_SRV,
+                               struct.pack(">HHH", 10, 10, p.port)
+                               + _encode_name(svc_name)))
+        return RCODE_OK, out
+
+    def _resolve_ptr(self, qname: str, qtype: int):
+        if qtype not in (TYPE_PTR, TYPE_ANY):
+            return RCODE_OK, []
+        octets = qname[: -len(".in-addr.arpa")].split(".")
+        if len(octets) != 4:
+            return RCODE_NXDOMAIN, []
+        ip = ".".join(reversed(octets))
+        for s in self._all_services():
+            if s.spec and s.spec.cluster_ip == ip:
+                target = (f"{s.metadata.name}."
+                          f"{s.metadata.namespace or 'default'}"
+                          f".svc.{self.domain}")
+                return RCODE_OK, [_rr(qname, TYPE_PTR, _encode_name(target))]
+        return RCODE_NXDOMAIN, []
+
+
+def _headless(svc: api.Service) -> bool:
+    return bool(svc.spec) and svc.spec.cluster_ip == "None"
+
+
+def _endpoint_hosts(eps: Optional[api.Endpoints]) -> List[Tuple[str, str]]:
+    """(host-label, ip) per ready endpoint address: the target pod name when
+    the endpoints controller recorded one, else the dashed IP."""
+    out = []
+    for ss in (eps.subsets or []) if eps else []:
+        for a in ss.addresses or []:
+            if not a.ip:
+                continue
+            host = (a.target_ref.name if a.target_ref and a.target_ref.name
+                    else a.ip.replace(".", "-"))
+            out.append((host, a.ip))
+    return out
+
+
+def resolve_udp(port: int, name: str, qtype: int = TYPE_A,
+                host: str = "127.0.0.1", timeout: float = 2.0) -> dict:
+    """One-shot client over a real UDP socket (tests + debugging)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(timeout)
+    try:
+        s.sendto(encode_query(0x1234, name, qtype), (host, port))
+        data, _ = s.recvfrom(4096)
+    finally:
+        s.close()
+    return decode_response(data)

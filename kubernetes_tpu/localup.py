@@ -25,6 +25,7 @@ class LocalCluster:
         self.data_dir = data_dir
         self.tpu_backend = tpu_backend
         self.master_url: Optional[str] = None
+        self.dns_addr: Optional[str] = None
         self.procs: List[subprocess.Popen] = []
 
     def _spawn(self, *args, pipe_stdout: bool = False) -> subprocess.Popen:
@@ -65,6 +66,12 @@ class LocalCluster:
                         "--node-name", f"node-{i:02d}", "--port", "0")
         self._spawn("kubernetes_tpu.proxy", "--master", self.master_url,
                     "--port", "0")
+        dns = self._spawn("kubernetes_tpu.dns", "--kube-master",
+                          self.master_url, "--dns-port", "0",
+                          pipe_stdout=True)
+        line = dns.stdout.readline()
+        if "listening on " in line:
+            self.dns_addr = line.strip().split("listening on ")[1]
         self._wait_ready(timeout)
         return self
 

@@ -111,6 +111,18 @@ def _pod_prepare_update(new: api.Pod, old: api.Pod):
         raise invalid("spec.nodeName: may only be set via the bindings subresource")
 
 
+def _service_prepare_update(new: api.Service, old: api.Service):
+    # clusterIP is immutable once set (reference service strategy); an
+    # update that omits it inherits the allocation rather than clearing it
+    old_ip = old.spec.cluster_ip if old.spec else ""
+    if new.spec is None:
+        new.spec = api.ServiceSpec()
+    if not new.spec.cluster_ip:
+        new.spec.cluster_ip = old_ip
+    elif old_ip and new.spec.cluster_ip != old_ip:
+        raise invalid("spec.clusterIP: field is immutable")
+
+
 def _event_prepare_create(ev: api.Event):
     if not ev.first_timestamp:
         ev.first_timestamp = _now_iso()
@@ -134,7 +146,8 @@ _register(ResourceDef("pods", "Pod", api.Pod, validator=validation.validate_pod,
 _register(ResourceDef("nodes", "Node", api.Node, namespaced=False,
                       validator=validation.validate_node))
 _register(ResourceDef("services", "Service", api.Service,
-                      validator=validation.validate_service))
+                      validator=validation.validate_service,
+                      prepare_for_update=_service_prepare_update))
 _register(ResourceDef("endpoints", "Endpoints", api.Endpoints,
                       list_kind="EndpointsList"))
 _register(ResourceDef("replicationcontrollers", "ReplicationController",
@@ -207,11 +220,82 @@ def _register_group_resources():
 _register_group_resources()
 
 
+class ServiceIPAllocator:
+    """Cluster-IP allocation from the service CIDR (reference
+    pkg/registry/service/ipallocator). Seeded lazily from the live service
+    list so a registry rebuilt from a durable store doesn't re-hand-out
+    taken IPs."""
+
+    def __init__(self, cidr: str = "10.96.0.0/12"):
+        import ipaddress
+        self.net = ipaddress.ip_network(cidr)
+        self._used: set = set()
+        self._lock = threading.Lock()
+        self._cursor = 0
+        self._size = self.net.num_addresses - 2  # skip network + broadcast
+
+    def seed(self, ips) -> None:
+        with self._lock:
+            self._used.update(ip for ip in ips if ip and ip != "None")
+
+    def allocate(self) -> str:
+        with self._lock:
+            for _ in range(self._size):
+                self._cursor = self._cursor % self._size + 1
+                ip = str(self.net[self._cursor])
+                if ip not in self._used:
+                    self._used.add(ip)
+                    return ip
+        raise invalid(f"service CIDR {self.net} exhausted")
+
+    def claim(self, ip: str) -> None:
+        import ipaddress
+        try:
+            addr = ipaddress.ip_address(ip)
+        except ValueError:
+            raise invalid(f"spec.clusterIP: invalid IP {ip!r}") from None
+        if addr not in self.net or addr in (self.net.network_address,
+                                            self.net.broadcast_address):
+            raise invalid(f"spec.clusterIP: {ip} not in service CIDR {self.net}")
+        with self._lock:
+            if ip in self._used:
+                raise invalid(f"spec.clusterIP: {ip} already allocated")
+            self._used.add(ip)
+
+    def release(self, ip: str) -> None:
+        with self._lock:
+            self._used.discard(ip)
+
+
 class Registry:
     """CRUD over typed objects, backed by one MemStore."""
 
     def __init__(self, store: Optional[MemStore] = None):
         self.store = store or MemStore()
+        self.service_ips = ServiceIPAllocator()
+        self._ips_seeded = False
+
+    def _seed_service_ips(self) -> None:
+        if self._ips_seeded:
+            return
+        items, _ = self.list("services")
+        self.service_ips.seed(
+            s.spec.cluster_ip for s in items if s.spec is not None)
+        self._ips_seeded = True
+
+    def _prepare_service(self, svc: api.Service) -> None:
+        """Allocate/claim the cluster IP (skydns + proxy both key off it).
+        "None" = headless: no allocation, DNS answers per-endpoint."""
+        self._seed_service_ips()
+        if svc.spec is None:
+            svc.spec = api.ServiceSpec()
+        ip = svc.spec.cluster_ip
+        if ip == "None":
+            return
+        if ip:
+            self.service_ips.claim(ip)
+        else:
+            svc.spec.cluster_ip = self.service_ips.allocate()
 
     def _def(self, resource: str) -> ResourceDef:
         try:
@@ -233,10 +317,19 @@ class Registry:
             meta.name = meta.generate_name + _new_uid()[4:]
         if rd.prepare_for_create:
             rd.prepare_for_create(obj)
+        allocated_ip = ""
+        if rd.name == "services":
+            self._prepare_service(obj)
+            # on any later failure the IP must go back — auto-allocated OR
+            # explicitly claimed, else a rejected manifest leaks it forever
+            if obj.spec and obj.spec.cluster_ip != "None":
+                allocated_ip = obj.spec.cluster_ip
         if rd.validator:
             try:
                 rd.validator(obj)
             except validation.ValidationError as e:
+                if allocated_ip:
+                    self.service_ips.release(allocated_ip)
                 raise invalid(str(e)) from None
         meta.uid = meta.uid or _new_uid()
         meta.creation_timestamp = meta.creation_timestamp or _now_iso()
@@ -244,6 +337,8 @@ class Registry:
         try:
             rv = self.store.create(key, to_dict(obj))
         except KeyExists:
+            if allocated_ip:
+                self.service_ips.release(allocated_ip)
             raise already_exists(rd.kind, meta.name) from None
         meta.resource_version = str(rv)
         return obj
@@ -328,7 +423,11 @@ class Registry:
             d, rv = self.store.delete(rd.key(namespace, name))
         except KeyNotFound:
             raise not_found(rd.kind, name) from None
-        return self._decode(rd, d, rv)
+        obj = self._decode(rd, d, rv)
+        if rd.name == "services" and obj.spec is not None \
+                and obj.spec.cluster_ip not in ("", "None"):
+            self.service_ips.release(obj.spec.cluster_ip)
+        return obj
 
     def watch(self, resource: str, namespace: str = "",
               since_rv: Optional[int] = None):

@@ -16,6 +16,11 @@ from __future__ import annotations
 import copy
 from typing import Any, Dict, List, Optional
 
+# patch MIME types (reference pkg/api/types.go PatchType) — the one
+# definition both the apiserver handler and the REST client import
+STRATEGIC_PATCH_TYPE = "application/strategic-merge-patch+json"
+MERGE_PATCH_TYPE = "application/merge-patch+json"
+
 # field name -> merge key (reference struct tags patchMergeKey)
 MERGE_KEYS = {
     "containers": "name",

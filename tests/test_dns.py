@@ -1,0 +1,223 @@
+"""Cluster DNS (kube-dns analog) over real UDP sockets.
+
+Parity target: reference cmd/kube-dns/dns.go — A records for
+{svc}.{ns}.svc.cluster.local off the service watch, headless services
+answering per-endpoint, SRV for named ports, PTR for allocated cluster
+IPs. Driven end-to-end here: API server -> informers -> DNS server ->
+UDP query/response on a real datagram socket (round-4 verdict #7).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.dns.server import (
+    DNSServer, RCODE_NXDOMAIN, RCODE_OK, RCODE_REFUSED, TYPE_A, TYPE_AAAA,
+    TYPE_PTR, TYPE_SRV, resolve_udp,
+)
+
+
+def mk_service(name, ns="default", cluster_ip="", ports=None, selector=None):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.ServiceSpec(cluster_ip=cluster_ip, selector=selector,
+                             ports=ports or [api.ServicePort(port=80)]))
+
+
+def mk_endpoints(name, ns="default", addrs=(), port=80, port_name=""):
+    return api.Endpoints(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        subsets=[api.EndpointSubset(
+            addresses=[api.EndpointAddress(
+                ip=ip,
+                target_ref=(api.ObjectReference(kind="Pod", name=pod)
+                            if pod else None))
+                for pod, ip in addrs],
+            ports=[api.EndpointPort(name=port_name, port=port)])])
+
+
+class TestStaticResolution:
+    """Unit level: record math without informers."""
+
+    def setup_method(self):
+        self.dns = DNSServer()
+        self.dns.set_static(
+            [mk_service("web", cluster_ip="10.0.0.7",
+                        ports=[api.ServicePort(port=80, name="http")]),
+             mk_service("db", cluster_ip="None",
+                        ports=[api.ServicePort(port=5432, name="pg")])],
+            [mk_endpoints("web", addrs=[("web-1", "10.4.0.1")], port=80,
+                          port_name="http"),
+             mk_endpoints("db", addrs=[("db-0", "10.4.1.1"),
+                                       ("", "10.4.1.2")], port=5432,
+                          port_name="pg")])
+
+    def test_cluster_ip_a_record(self):
+        rcode, ans = self.dns.resolve("web.default.svc.cluster.local", TYPE_A)
+        assert rcode == RCODE_OK and len(ans) == 1
+
+    def test_headless_returns_endpoint_ips(self):
+        rcode, ans = self.dns.resolve("db.default.svc.cluster.local", TYPE_A)
+        assert rcode == RCODE_OK and len(ans) == 2
+
+    def test_headless_per_pod_record(self):
+        rcode, ans = self.dns.resolve("db-0.db.default.svc.cluster.local",
+                                      TYPE_A)
+        assert rcode == RCODE_OK and len(ans) == 1
+        # unnamed address resolvable by dashed ip
+        rcode, ans = self.dns.resolve(
+            "10-4-1-2.db.default.svc.cluster.local", TYPE_A)
+        assert rcode == RCODE_OK and len(ans) == 1
+
+    def test_srv_named_port(self):
+        rcode, ans = self.dns.resolve(
+            "_http._tcp.web.default.svc.cluster.local", TYPE_SRV)
+        assert rcode == RCODE_OK and len(ans) == 1
+
+    def test_srv_headless_per_endpoint(self):
+        rcode, ans = self.dns.resolve(
+            "_pg._tcp.db.default.svc.cluster.local", TYPE_SRV)
+        assert rcode == RCODE_OK and len(ans) == 2
+
+    def test_nxdomain_inside_domain(self):
+        rcode, _ = self.dns.resolve("ghost.default.svc.cluster.local", TYPE_A)
+        assert rcode == RCODE_NXDOMAIN
+
+    def test_refused_outside_domain(self):
+        rcode, _ = self.dns.resolve("example.com", TYPE_A)
+        assert rcode == RCODE_REFUSED
+
+    def test_aaaa_on_existing_name_empty_noerror(self):
+        rcode, ans = self.dns.resolve("web.default.svc.cluster.local",
+                                      TYPE_AAAA)
+        assert rcode == RCODE_OK and ans == []
+
+    def test_ptr_for_cluster_ip(self):
+        rcode, ans = self.dns.resolve("7.0.0.10.in-addr.arpa", TYPE_PTR)
+        assert rcode == RCODE_OK and len(ans) == 1
+
+
+class TestLiveUDP:
+    """The full path: apiserver -> informers -> UDP socket."""
+
+    @pytest.fixture()
+    def stack(self):
+        server = APIServer().start()
+        client = RESTClient.for_server(server)
+        dns = None
+        try:
+            yield server, client, lambda: DNSServer(
+                RESTClient.for_server(server))
+        finally:
+            server.stop()
+
+    def test_service_resolves_over_udp(self, stack):
+        server, client, make_dns = stack
+        created = client.create("services", mk_service(
+            "api", selector={"app": "api"},
+            ports=[api.ServicePort(port=443, name="https")]))
+        # the registry allocated a cluster IP (no IP was requested)
+        assert created.spec.cluster_ip not in ("", "None")
+        dns = make_dns().start()
+        try:
+            r = resolve_udp(dns.port, "api.default.svc.cluster.local")
+            assert r["rcode"] == RCODE_OK
+            assert [a[2] for a in r["answers"]] == [created.spec.cluster_ip]
+            # PTR back
+            rev = ".".join(reversed(created.spec.cluster_ip.split(".")))
+            r = resolve_udp(dns.port, f"{rev}.in-addr.arpa", TYPE_PTR)
+            assert r["answers"][0][2] == "api.default.svc.cluster.local"
+            # SRV
+            r = resolve_udp(dns.port,
+                            "_https._tcp.api.default.svc.cluster.local",
+                            TYPE_SRV)
+            assert r["answers"][0][2][2] == 443
+        finally:
+            dns.stop()
+
+    def test_headless_follows_endpoints_watch(self, stack):
+        server, client, make_dns = stack
+        client.create("services", mk_service("hl", cluster_ip="None"))
+        dns = make_dns().start()
+        try:
+            r = resolve_udp(dns.port, "hl.default.svc.cluster.local")
+            assert r["rcode"] == RCODE_OK and r["answers"] == []
+            # endpoints appear -> records appear via the watch, no restart
+            client.create("endpoints", mk_endpoints(
+                "hl", addrs=[("hl-0", "10.9.0.1"), ("hl-1", "10.9.0.2")]))
+            import time
+            deadline = time.monotonic() + 10
+            ips = []
+            while time.monotonic() < deadline:
+                r = resolve_udp(dns.port, "hl.default.svc.cluster.local")
+                ips = sorted(a[2] for a in r["answers"])
+                if ips:
+                    break
+                time.sleep(0.05)
+            assert ips == ["10.9.0.1", "10.9.0.2"]
+            r = resolve_udp(dns.port, "hl-1.hl.default.svc.cluster.local")
+            assert [a[2] for a in r["answers"]] == ["10.9.0.2"]
+        finally:
+            dns.stop()
+
+    def test_nxdomain_and_refused_over_udp(self, stack):
+        server, client, make_dns = stack
+        dns = make_dns().start()
+        try:
+            assert resolve_udp(dns.port,
+                               "nope.default.svc.cluster.local")["rcode"] \
+                == RCODE_NXDOMAIN
+            assert resolve_udp(dns.port, "example.com")["rcode"] \
+                == RCODE_REFUSED
+        finally:
+            dns.stop()
+
+
+class TestClusterIPAllocation:
+    def test_allocation_claim_conflict_release(self):
+        server = APIServer().start()
+        try:
+            client = RESTClient.for_server(server)
+            a = client.create("services", mk_service("a"))
+            b = client.create("services", mk_service("b"))
+            assert a.spec.cluster_ip != b.spec.cluster_ip
+            # explicit claim of a taken IP is rejected
+            from kubernetes_tpu.client.rest import ApiError
+            with pytest.raises(ApiError) as ei:
+                client.create("services", mk_service(
+                    "c", cluster_ip=a.spec.cluster_ip))
+            assert ei.value.code == 422
+            # delete releases; the IP becomes claimable
+            client.delete("services", "a", "default")
+            c = client.create("services", mk_service(
+                "c", cluster_ip=a.spec.cluster_ip))
+            assert c.spec.cluster_ip == a.spec.cluster_ip
+            # immutability on update
+            c.spec.cluster_ip = "10.0.0.250"
+            with pytest.raises(ApiError) as ei:
+                client.update("services", c)
+            assert ei.value.code == 422
+        finally:
+            server.stop()
+
+    def test_failed_create_releases_claimed_ip(self):
+        """A 422 on a manifest with an explicit clusterIP must put the IP
+        back — else the corrected retry fails 'already allocated' forever."""
+        server = APIServer().start()
+        try:
+            client = RESTClient.for_server(server)
+            from kubernetes_tpu.client.rest import ApiError
+            bad = mk_service("svc", cluster_ip="10.96.0.77")
+            bad.spec.ports = None  # invalid: no ports
+            with pytest.raises(ApiError):
+                client.create("services", bad)
+            good = client.create("services",
+                                 mk_service("svc", cluster_ip="10.96.0.77"))
+            assert good.spec.cluster_ip == "10.96.0.77"
+            # network/broadcast addresses of the CIDR are not claimable
+            with pytest.raises(ApiError):
+                client.create("services",
+                              mk_service("net0", cluster_ip="10.96.0.0"))
+        finally:
+            server.stop()
