@@ -485,12 +485,23 @@ class NodeSystemInfo:
 
 
 @dataclass
+class DaemonEndpoint:
+    port: int = 0
+
+
+@dataclass
+class NodeDaemonEndpoints:
+    kubelet_endpoint: Optional[DaemonEndpoint] = None
+
+
+@dataclass
 class NodeStatus:
     capacity: Optional[Dict[str, str]] = None
     allocatable: Optional[Dict[str, str]] = None
     phase: str = ""
     conditions: Optional[List[NodeCondition]] = None
     addresses: Optional[List[NodeAddress]] = None
+    daemon_endpoints: Optional[NodeDaemonEndpoints] = None
     node_info: Optional[NodeSystemInfo] = None
     images: Optional[List[ContainerImage]] = None
 

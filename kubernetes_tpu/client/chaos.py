@@ -180,7 +180,8 @@ class ChaosController:
                 return iv
         return None
 
-    def _request_once(self, method: str, path: str, body=None) -> dict:
+    def _request_once(self, method: str, path: str, body=None,
+                      content_type=None) -> dict:
         iv = self._consult(method, path)
         if iv is not None:
             if self.notifier:
@@ -196,7 +197,8 @@ class ChaosController:
                     raise ApiError(code, out.get("reason", "Unknown"),
                                    out.get("message", ""))
                 return out
-        return self._orig_request_once(method, path, body)
+        return self._orig_request_once(method, path, body,
+                                       content_type=content_type)
 
     def _watch(self, resource: str, namespace: str = "", **kw):
         # watches open a dedicated connection; chaos at open time models a

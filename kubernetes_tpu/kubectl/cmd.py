@@ -502,6 +502,110 @@ def cmd_version(args) -> int:
     return 0
 
 
+def _kubelet_endpoint(client, pod_name: str, ns: str):
+    """(host, port, pod) of the kubelet serving a pod: pod -> spec.nodeName
+    -> node.status.daemonEndpoints + InternalIP (server.go:237 routes)."""
+    pod = client.get("pods", pod_name, ns)
+    node_name = pod.spec.node_name if pod.spec else ""
+    if not node_name:
+        raise CommandError(f"pod {pod_name!r} is not scheduled yet")
+    node = client.get("nodes", node_name)
+    st = node.status
+    de = st.daemon_endpoints if st else None
+    port = (de.kubelet_endpoint.port
+            if de and de.kubelet_endpoint else 0)
+    if not port:
+        raise CommandError(
+            f"node {node_name!r} publishes no kubelet endpoint "
+            "(is its kubelet running with a node server?)")
+    host = "127.0.0.1"
+    for addr in (st.addresses or []):
+        if addr.type == "InternalIP" and addr.address:
+            host = addr.address
+            break
+    return host, port, pod
+
+
+def _default_container(pod, requested: Optional[str]) -> str:
+    names = [c.name for c in (pod.spec.containers or [])]
+    if requested:
+        if requested not in names:
+            raise CommandError(
+                f"container {requested!r} not in pod (have {names})")
+        return requested
+    if not names:
+        raise CommandError("pod has no containers")
+    return names[0]
+
+
+def cmd_logs(args) -> int:
+    """kubectl logs POD [-c C] [--tail N] [-p]: read the container's real
+    log stream from the kubelet node server (GetContainerLogs analog)."""
+    import http.client as hc
+    client = _client(args)
+    ns = _ns(args)
+    host, port, pod = _kubelet_endpoint(client, args.pod, ns)
+    cname = _default_container(pod, args.container)
+    q = []
+    if args.tail is not None:
+        q.append(f"tailLines={args.tail}")
+    if args.previous:
+        q.append("previous=true")
+    conn = hc.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", f"/containerLogs/{ns}/{args.pod}/{cname}"
+                            + (("?" + "&".join(q)) if q else ""))
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+    if resp.status != 200:
+        raise CommandError(f"kubelet: {resp.status} {body.strip()}")
+    sys.stdout.write(body)
+    return 0
+
+
+def cmd_exec(args) -> int:
+    """kubectl exec POD [-c C] -- CMD...: run an argv in the container's
+    context via the kubelet node server."""
+    import http.client as hc
+    from urllib.parse import quote as _q
+    # argparse.REMAINDER swallows flags after the pod name, so -c/--container
+    # arrives inside cmd; split at "--" and parse the flag part by hand
+    cmd = list(args.cmd)
+    if "--" in cmd:
+        i = cmd.index("--")
+        flags, cmd = cmd[:i], cmd[i + 1:]
+        j = 0
+        while j < len(flags):
+            if flags[j] in ("-c", "--container") and j + 1 < len(flags):
+                args.container = flags[j + 1]
+                j += 2
+            else:
+                raise CommandError(f"unknown argument {flags[j]!r} "
+                                   "(flags go before --)")
+    args.cmd = cmd
+    if not args.cmd:
+        raise CommandError("command required: kubectl exec POD -- CMD ...")
+    client = _client(args)
+    ns = _ns(args)
+    host, port, pod = _kubelet_endpoint(client, args.pod, ns)
+    cname = _default_container(pod, args.container)
+    qs = "&".join(f"command={_q(c)}" for c in args.cmd)
+    conn = hc.HTTPConnection(host, port, timeout=60)
+    try:
+        conn.request("POST", f"/exec/{ns}/{args.pod}/{cname}?{qs}")
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+    if resp.status != 200:
+        raise CommandError(f"kubelet: {resp.status} {body.strip()}")
+    out = json.loads(body)
+    sys.stdout.write(out.get("output", ""))
+    return int(out.get("rc", 0))
+
+
 def cmd_api_versions(args) -> int:
     groups = sorted({rd.api_version for rd in RESOURCES.values()})
     for g in groups:
@@ -599,6 +703,18 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("--port", type=int, required=True)
     ex.add_argument("--target-port", type=int, default=None)
     ex.add_argument("--name", default=None)
+
+    lo = add("logs", cmd_logs)
+    lo.add_argument("pod")
+    lo.add_argument("-c", "--container", default=None)
+    lo.add_argument("--tail", type=int, default=None)
+    lo.add_argument("-p", "--previous", action="store_true")
+
+    exe = add("exec", cmd_exec)
+    exe.add_argument("pod")
+    exe.add_argument("-c", "--container", default=None)
+    exe.add_argument("cmd", nargs=argparse.REMAINDER,
+                     help="command after --")
 
     add("version", cmd_version)
     add("api-versions", cmd_api_versions)

@@ -16,8 +16,10 @@ import threading
 
 from kubernetes_tpu.apis.componentconfig import KubeletConfiguration
 from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
 from kubernetes_tpu.kubelet.runtime import FakeCadvisor, FakeRuntime
-from kubernetes_tpu.utils.debugserver import DebugServer, client_from_url
+from kubernetes_tpu.kubelet.server import KubeletServer
+from kubernetes_tpu.utils.debugserver import client_from_url
 
 
 def main(argv=None) -> int:
@@ -29,20 +31,30 @@ def main(argv=None) -> int:
     p.add_argument("--cpu", default="4")
     p.add_argument("--memory", default="32Gi")
     p.add_argument("--node-status-update-frequency", type=float, default=10.0)
+    p.add_argument("--runtime", choices=("fake", "process"), default="fake",
+                   help="fake = hollow node; process = real OS subprocesses "
+                        "with logs/exec served on the node port")
+    p.add_argument("--root-dir", default="",
+                   help="pod sandbox/log root for --runtime process")
     a = p.parse_args(argv)
     cfg = KubeletConfiguration(
         port=a.port, max_pods=a.max_pods,
         node_status_update_frequency_seconds=a.node_status_update_frequency)
 
     client = client_from_url(a.master, qps=100, burst=200)
-    kl = Kubelet(client, a.node_name, runtime=FakeRuntime(),
+    runtime = (ProcessRuntime(root_dir=a.root_dir or None)
+               if a.runtime == "process" else FakeRuntime())
+    kl = Kubelet(client, a.node_name, runtime=runtime,
                  cadvisor=FakeCadvisor(cpu=a.cpu, memory=a.memory,
                                        pods=str(a.max_pods)),
                  heartbeat_period=a.node_status_update_frequency)
+    # the node API server (server.go:237): logs/exec/pods + debug bundle,
+    # started first so registration publishes the bound port
+    server = KubeletServer(runtime, port=cfg.port,
+                           configz={"componentconfig": cfg}).start()
+    kl.server_port = server.port
     kl.start()
-    debug = DebugServer(port=cfg.port,
-                        configz={"componentconfig": cfg}).start()
-    print(f"kubelet {a.node_name} debug on http://127.0.0.1:{debug.port}",
+    print(f"kubelet {a.node_name} debug on http://127.0.0.1:{server.port}",
           flush=True)
 
     stop = threading.Event()
@@ -50,7 +62,9 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *a_: stop.set())
     stop.wait()
     kl.stop()
-    debug.stop()
+    server.stop()
+    if isinstance(runtime, ProcessRuntime):
+        runtime.cleanup()
     return 0
 
 

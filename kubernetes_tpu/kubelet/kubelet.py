@@ -49,6 +49,10 @@ class Kubelet:
         self.eviction_period = eviction_period
         self.node_labels = dict(node_labels or {})
         self.node_labels.setdefault(api.LABEL_HOSTNAME, node_name)
+        # the node API server's bound port (kubelet/server.py); published in
+        # node.status.daemonEndpoints so kubectl logs/exec can find us
+        # (reference --port + server.go:237)
+        self.server_port: int = 0
         self.recorder = EventRecorder(client, "kubelet", source_host=node_name)
         self._pod_ip_base = pod_ip_base
         self._ip_counter = 0
@@ -91,9 +95,12 @@ class Kubelet:
                 conditions=[_ready_condition()],
                 addresses=[api.NodeAddress(type="InternalIP",
                                            address=self._node_ip())],
+                daemon_endpoints=api.NodeDaemonEndpoints(
+                    kubelet_endpoint=api.DaemonEndpoint(
+                        port=self.server_port)) if self.server_port else None,
                 node_info=api.NodeSystemInfo(
                     kubelet_version="kubernetes-tpu-0.1",
-                    container_runtime_version="fake://0.1")))
+                    container_runtime_version=type(self.runtime).__name__)))
         try:
             self.client.create("nodes", node)
         except ApiError as e:
@@ -127,6 +134,9 @@ class Kubelet:
                     else "KubeletHasSufficientMemory"),
             last_heartbeat_time=now_iso()))
         node.status.conditions = conds
+        if self.server_port:
+            node.status.daemon_endpoints = api.NodeDaemonEndpoints(
+                kubelet_endpoint=api.DaemonEndpoint(port=self.server_port))
         try:
             # status PATCH, not PUT: concurrent spec writers (cordon, taints)
             # can no longer be clobbered by a stale heartbeat read
@@ -134,7 +144,8 @@ class Kubelet:
             # conditions list wholesale, which the heartbeat owns)
             enc = scheme.encode(node)
             status = {k: enc["status"].get(k)
-                      for k in ("conditions", "allocatable", "capacity")
+                      for k in ("conditions", "allocatable", "capacity",
+                                "daemonEndpoints", "addresses")
                       if enc["status"].get(k) is not None}
             self.client.patch(
                 "nodes", node.metadata.name, {"status": status},
@@ -296,7 +307,15 @@ class Kubelet:
             if pod is None:
                 continue
             policy = (pod.spec.restart_policy or "Always") if pod.spec else "Always"
-            if policy in ("Always", "OnFailure"):
+            # real runtimes report exit codes; None (hollow kill) counts as
+            # failure. OnFailure restarts only failures; a clean exit under
+            # OnFailure/Never leaves the container terminated, and the POD
+            # completes only when EVERY container has terminated — a clean
+            # sidecar exit must not kill a still-working sibling
+            # (kubelet.go GetPhase over all container statuses)
+            rc = self.runtime.exit_code(ev.pod_key, ev.container)
+            succeeded = rc == 0
+            if policy == "Always" or (policy == "OnFailure" and not succeeded):
                 self.runtime.restart_container(ev.pod_key, ev.container)
                 self.probes.forget_container(ev.pod_key, ev.container)
                 self.recorder.event(
@@ -304,16 +323,25 @@ class Kubelet:
                     f"Restarted container {ev.container}")
                 # the probe loop below writes the status (restart_counts
                 # changed its signature) with probe-derived readiness
-            else:  # Never: terminated containers end the pod
-                # terminal BEFORE kill: the informer dispatch thread must
-                # never observe killed-but-not-yet-terminal and resurrect
-                self._terminal.add(ev.pod_key)
-                self.runtime.kill_pod(ev.pod_key)
-                self.probes.forget_pod(ev.pod_key)
+                continue
+            states = self.runtime.container_states(ev.pod_key)
+            if any(s == "running" for s in states.values()):
+                continue  # siblings still at work; pod stays Running
+            all_ok = all(self.runtime.exit_code(ev.pod_key, c) == 0
+                         for c in states)
+            # terminal BEFORE kill: the informer dispatch thread must
+            # never observe killed-but-not-yet-terminal and resurrect
+            self._terminal.add(ev.pod_key)
+            self.runtime.kill_pod(ev.pod_key)
+            self.probes.forget_pod(ev.pod_key)
+            if all_ok:
+                self._set_status(pod, api.POD_SUCCEEDED, reason="Completed",
+                                 message="all containers exited 0")
+            else:
                 self._set_status(pod, api.POD_FAILED,
                                  reason="ContainersDied",
                                  message=f"container {ev.container} died "
-                                         f"(restartPolicy=Never)")
+                                         f"(restartPolicy={policy})")
 
         # probes: readiness feeds POD_READY; liveness failures kill (the
         # next relist restarts per policy)
@@ -381,7 +409,10 @@ class Kubelet:
         self.pod_informer.stop()
 
     def _node_ip(self) -> str:
-        return "192.168.0.1"
+        # hollow nodes fabricate an address (nothing routes to them anyway);
+        # a real-process runtime is reachable on loopback, and kubectl
+        # logs/exec dial node.status.addresses — they must get a real one
+        return "192.168.0.1" if self.runtime.fakes_network else "127.0.0.1"
 
 
 def _ready_condition() -> api.NodeCondition:

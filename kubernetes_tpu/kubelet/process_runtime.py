@@ -1,0 +1,273 @@
+"""ProcessRuntime: a pod runtime that runs REAL OS processes.
+
+Parity target: reference pkg/kubelet/dockertools/docker_manager.go — the
+runtime that makes "scheduled" mean something physical. There is no
+container engine in this environment, so the tpu-native analog runs one
+host subprocess per container:
+
+  - a container with spec.command/args runs exactly that argv;
+  - a command-less container (the benchmark's "pause" image) runs the
+    pause-equivalent: a sleep loop, the moral heir of build/pause/pause.c;
+  - stdout+stderr stream to a per-container log file under the runtime
+    root, which is what /containerLogs and `kubectl logs` serve
+    (docker_manager.go GetContainerLogs); the previous incarnation's log
+    survives one restart as `.prev` (kubectl logs --previous);
+  - `exec` runs an argv with the container's environment and working
+    directory and captures its output (docker exec analog,
+    pkg/kubelet/server/server.go:237-298 serves it);
+  - PLEG observes real exits: container_states() polls the child
+    processes, so a killed process produces CONTAINER_DIED and the
+    kubelet's restart policy applies to a real PID.
+
+Isolation is process-level only (no namespaces/cgroups — this is a
+scheduling-framework runtime, not a container engine). The FakeRuntime
+remains the hollow-node default; ProcessRuntime is selected per-kubelet
+(--runtime process).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.kubelet.runtime import PodRuntime, RunningPod
+
+# the pause-equivalent (build/pause/pause.c: pause forever, cheaply)
+PAUSE_ARGV = ["/bin/sh", "-c", "while :; do sleep 3600; done"]
+
+
+class _Proc:
+    """One container's live process + its log handle."""
+
+    def __init__(self, popen: subprocess.Popen, log_path: str, log_file):
+        self.popen = popen
+        self.log_path = log_path
+        self.log_file = log_file
+
+
+class ProcessRuntime(PodRuntime):
+    """Subprocess-per-container runtime. Thread-safe; all state keyed by
+    `ns/name` pod keys like the rest of the kubelet."""
+
+    fakes_network = False
+
+    def __init__(self, root_dir: Optional[str] = None,
+                 grace_seconds: float = 2.0):
+        self.root = root_dir or os.path.join(
+            "/tmp", f"kubernetes-tpu-pods-{os.getpid()}")
+        os.makedirs(self.root, exist_ok=True)
+        self.grace_seconds = grace_seconds
+        self._lock = threading.Lock()
+        self._pods: Dict[str, RunningPod] = {}
+        self._procs: Dict[str, Dict[str, _Proc]] = {}  # key -> cname -> proc
+
+    # --- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _argv(c: api.Container) -> List[str]:
+        if c.command:
+            return list(c.command) + list(c.args or [])
+        if c.args:
+            # image entrypoints don't exist here; args alone run via sh
+            return ["/bin/sh", "-c", " ".join(c.args)]
+        return PAUSE_ARGV
+
+    def _pod_dir(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "_"))
+
+    def _env(self, pod: api.Pod, c: api.Container) -> Dict[str, str]:
+        env = dict(os.environ)
+        env["POD_NAME"] = pod.metadata.name
+        env["POD_NAMESPACE"] = pod.metadata.namespace or "default"
+        env["CONTAINER_NAME"] = c.name
+        for e in c.env or []:
+            if e.name:
+                env[e.name] = e.value or ""
+        return env
+
+    def _spawn(self, key: str, pod: api.Pod, c: api.Container) -> _Proc:
+        pod_dir = self._pod_dir(key)
+        os.makedirs(pod_dir, exist_ok=True)
+        log_path = os.path.join(pod_dir, f"{c.name}.log")
+        if os.path.exists(log_path):
+            # one previous incarnation's log survives (kubectl logs -p)
+            shutil.move(log_path, log_path + ".prev")
+        log_file = open(log_path, "ab", buffering=0)
+        popen = subprocess.Popen(
+            self._argv(c), cwd=pod_dir, env=self._env(pod, c),
+            stdout=log_file, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, start_new_session=True)
+        return _Proc(popen, log_path, log_file)
+
+    @staticmethod
+    def _terminate(proc: _Proc, grace: float) -> None:
+        p = proc.popen
+        if p.poll() is None:
+            try:
+                # the whole session: sh -c children must die with the shell
+                os.killpg(p.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            deadline = time.monotonic() + grace
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                p.wait(timeout=5)
+        try:
+            proc.log_file.close()
+        except OSError:
+            pass
+
+    # --- PodRuntime -----------------------------------------------------------
+
+    def sync_pod(self, pod: api.Pod) -> None:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        with self._lock:
+            if key in self._pods:
+                return
+            procs: Dict[str, _Proc] = {}
+            try:
+                for c in pod.spec.containers or []:
+                    procs[c.name] = self._spawn(key, pod, c)
+            except OSError:
+                # a later container's argv failed to spawn: reap the
+                # already-started siblings — nothing may outlive an
+                # unregistered pod (kill_pod couldn't find it)
+                for proc in procs.values():
+                    self._terminate(proc, 0.5)
+                raise
+            self._procs[key] = procs
+            self._pods[key] = RunningPod(
+                pod=pod,
+                container_ids=[f"proc://{procs[c.name].popen.pid}"
+                               for c in (pod.spec.containers or [])])
+
+    def kill_pod(self, pod_key: str) -> None:
+        with self._lock:
+            procs = self._procs.pop(pod_key, {})
+            self._pods.pop(pod_key, None)
+        for proc in procs.values():
+            self._terminate(proc, self.grace_seconds)
+
+    def running(self) -> Dict[str, RunningPod]:
+        with self._lock:
+            return dict(self._pods)
+
+    def container_states(self, pod_key: str) -> Dict[str, str]:
+        """Real observation: poll each child PID (the PLEG relist source)."""
+        with self._lock:
+            procs = self._procs.get(pod_key)
+            if procs is None:
+                return {}
+            return {cname: ("running" if proc.popen.poll() is None
+                            else "dead")
+                    for cname, proc in procs.items()}
+
+    def exit_code(self, pod_key: str, cname: str) -> Optional[int]:
+        with self._lock:
+            proc = self._procs.get(pod_key, {}).get(cname)
+        if proc is None:
+            return None
+        rc = proc.popen.poll()
+        # negative = killed by signal: report 128+N like a shell would
+        return (128 - rc) if rc is not None and rc < 0 else rc
+
+    def kill_container(self, pod_key: str, cname: str) -> None:
+        with self._lock:
+            proc = self._procs.get(pod_key, {}).get(cname)
+        if proc is not None:
+            self._terminate(proc, self.grace_seconds)
+
+    def restart_container(self, pod_key: str, cname: str) -> None:
+        with self._lock:
+            rp = self._pods.get(pod_key)
+            procs = self._procs.get(pod_key)
+            if rp is None or procs is None:
+                return
+            old = procs.get(cname)
+        if old is not None:
+            self._terminate(old, self.grace_seconds)
+        with self._lock:
+            rp = self._pods.get(pod_key)
+            procs = self._procs.get(pod_key)
+            if rp is None or procs is None:  # pod killed meanwhile
+                return
+            spec = next((c for c in (rp.pod.spec.containers or [])
+                         if c.name == cname), None)
+            if spec is None:
+                return
+            procs[cname] = self._spawn(pod_key, rp.pod, spec)
+            rp.restart_counts[cname] = rp.restart_counts.get(cname, 0) + 1
+            for i, c in enumerate(rp.pod.spec.containers or []):
+                if c.name == cname:
+                    rp.container_ids[i] = \
+                        f"proc://{procs[cname].popen.pid}"
+
+    # --- logs / exec (what the kubelet server serves) -------------------------
+
+    def logs(self, pod_key: str, cname: str, tail_lines: Optional[int] = None,
+             previous: bool = False) -> str:
+        with self._lock:
+            proc = self._procs.get(pod_key, {}).get(cname)
+        if proc is None:
+            raise KeyError(f"no container {cname!r} in pod {pod_key!r}")
+        path = proc.log_path + (".prev" if previous else "")
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return ""
+        text = data.decode("utf-8", "replace")
+        if tail_lines is not None and tail_lines >= 0:
+            lines = text.splitlines(keepends=True)
+            text = "".join(lines[-tail_lines:]) if tail_lines else ""
+        return text
+
+    def exec(self, pod_key: str, cname: str, command: List[str],
+             timeout: float = 30.0):
+        """(rc, combined output) of an argv run in the container's context
+        (cwd + env) — the docker-exec analog."""
+        with self._lock:
+            rp = self._pods.get(pod_key)
+            proc = self._procs.get(pod_key, {}).get(cname)
+        if rp is None or proc is None:
+            raise KeyError(f"no container {cname!r} in pod {pod_key!r}")
+        spec = next((c for c in (rp.pod.spec.containers or [])
+                     if c.name == cname), None)
+        try:
+            res = subprocess.run(
+                list(command), cwd=self._pod_dir(pod_key),
+                env=self._env(rp.pod, spec) if spec else None,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL, timeout=timeout)
+            return res.returncode, res.stdout.decode("utf-8", "replace")
+        except subprocess.TimeoutExpired:
+            return 124, f"command timed out after {timeout}s\n"
+        except FileNotFoundError as e:
+            return 127, f"{e}\n"
+
+    def exec_probe(self, pod_key: str, cname: str, command) -> int:
+        try:
+            rc, _ = self.exec(pod_key, cname, list(command or ["true"]),
+                              timeout=5.0)
+            return rc
+        except KeyError:
+            return 1
+
+    def cleanup(self) -> None:
+        """Kill everything and remove the runtime root (tests/teardown)."""
+        with self._lock:
+            keys = list(self._procs)
+        for k in keys:
+            self.kill_pod(k)
+        shutil.rmtree(self.root, ignore_errors=True)

@@ -45,6 +45,12 @@ class PodRuntime:
     def container_states(self, pod_key: str) -> Dict[str, str]:
         return {}          # no per-container observability -> no PLEG events
 
+    def exit_code(self, pod_key: str, cname: str) -> Optional[int]:
+        """Exit code of a dead container; None = unknown (hollow runtimes
+        kill containers without a code — treated as failure by the restart
+        policy, which matches 'it crashed')."""
+        return None
+
     def kill_container(self, pod_key: str, cname: str) -> None:
         pass
 

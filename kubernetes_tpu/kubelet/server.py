@@ -1,0 +1,147 @@
+"""The kubelet's node API server (:10250 analog).
+
+Parity target: reference pkg/kubelet/server/server.go:237-298 — the routes
+a node agent serves beyond health/metrics:
+
+  GET  /pods                                      running pod list
+  GET  /containerLogs/{ns}/{pod}/{container}      ?tailLines=N&previous=true
+  POST /exec/{ns}/{pod}/{container}?command=a&command=b    run argv
+  GET  /healthz, /metrics, /configz               debug bundle
+
+The reference streams exec/attach/portforward over SPDY
+(pkg/util/httpstream); this framework's clients are its own, so exec
+answers a plain JSON {rc, output} over HTTP and logs stream as text/plain —
+same capability, native wire. kubectl logs/exec resolve the pod's node,
+read the kubelet endpoint from node.status.daemonEndpoints, and call
+these routes directly (the reference's apiserver->node proxy path
+collapses to a direct hop in a flat test network).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu.utils.debugserver import debug_route
+
+_LOGS = re.compile(r"^/containerLogs/([^/]+)/([^/]+)/([^/]+)$")
+_EXEC = re.compile(r"^/(?:exec|run)/([^/]+)/([^/]+)/([^/]+)$")
+
+
+class KubeletServer:
+    """HTTP server over a PodRuntime (+ the debug endpoint bundle)."""
+
+    def __init__(self, runtime, port: int = 0, host: str = "127.0.0.1",
+                 healthz: Optional[Callable[[], bool]] = None,
+                 configz: Optional[Dict[str, object]] = None):
+        self.runtime = runtime
+        self._host = host
+        self._port = port
+        self.healthz = healthz or (lambda: True)
+        self.configz: Dict[str, object] = dict(configz or {})
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "not started"
+        return self._httpd.server_address[1]
+
+    def start(self) -> "KubeletServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, body: bytes, ctype="text/plain"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code, payload):
+                self._send(code, json.dumps(payload).encode(),
+                           "application/json")
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                q = parse_qs(url.query, keep_blank_values=True)
+                hit = debug_route(url.path, outer.healthz, outer.configz)
+                if hit is not None:
+                    return self._send(*hit[:2], hit[2])
+                if url.path == "/pods":
+                    from kubernetes_tpu.api.serialization import scheme
+                    items = [scheme.encode(rp.pod)
+                             for rp in outer.runtime.running().values()]
+                    return self._send_json(200, {"kind": "PodList",
+                                                 "items": items})
+                m = _LOGS.match(url.path)
+                if m:
+                    return self._serve_logs(m, q)
+                self._send(404, b"not found")
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                url = urlparse(self.path)
+                # keep_blank_values: an empty argv element ('grep "" f') is
+                # a real argument, not absence of one
+                q = parse_qs(url.query, keep_blank_values=True)
+                m = _EXEC.match(url.path)
+                if m:
+                    return self._serve_exec(m, q)
+                self._send(404, b"not found")
+
+            def _serve_logs(self, m, q):
+                ns, pod, container = m.groups()
+                logs = getattr(outer.runtime, "logs", None)
+                if logs is None:
+                    return self._send(501, b"runtime has no log access")
+                tail = q.get("tailLines", [None])[0]
+                prev = q.get("previous", ["false"])[0] in ("true", "1")
+                try:
+                    tail_n = int(tail) if tail else None
+                except ValueError:
+                    return self._send(400, f"bad tailLines {tail!r}".encode())
+                try:
+                    text = logs(f"{ns}/{pod}", container,
+                                tail_lines=tail_n, previous=prev)
+                except KeyError as e:
+                    return self._send(404, str(e).encode())
+                self._send(200, text.encode("utf-8", "replace"))
+
+            def _serve_exec(self, m, q):
+                ns, pod, container = m.groups()
+                execfn = getattr(outer.runtime, "exec", None)
+                if execfn is None:
+                    return self._send(501, b"runtime has no exec")
+                command = q.get("command", [])
+                if not command:
+                    return self._send(400, b"command required")
+                try:
+                    rc, output = execfn(f"{ns}/{pod}", container, command)
+                except KeyError as e:
+                    return self._send(404, str(e).encode())
+                self._send_json(200, {"rc": rc, "output": output})
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="kubelet-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -25,6 +25,28 @@ def render_configz(configz: Dict[str, object]) -> dict:
             for name, o in configz.items()}
 
 
+def debug_route(path: str, healthz: Callable[[], bool],
+                configz: Dict[str, object]):
+    """Shared /healthz /metrics /configz handling for every component
+    server (DebugServer + the kubelet node server). Returns
+    (code, body bytes, content-type) or None when the path isn't a debug
+    route."""
+    if path in ("/healthz", "/healthz/ping"):
+        ok = False
+        try:
+            ok = healthz()
+        except Exception:
+            pass
+        return (200 if ok else 500, b"ok" if ok else b"unhealthy",
+                "text/plain")
+    if path == "/metrics":
+        return 200, METRICS.render().encode(), "text/plain"
+    if path == "/configz":
+        return (200, json.dumps(render_configz(configz)).encode(),
+                "application/json")
+    return None
+
+
 class DebugServer:
     """healthz/metrics/configz endpoint bundle for a component process."""
 
@@ -63,20 +85,9 @@ class DebugServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path in ("/healthz", "/healthz/ping"):
-                    ok = False
-                    try:
-                        ok = outer.healthz()
-                    except Exception:
-                        pass
-                    return self._send(200 if ok else 500,
-                                      b"ok" if ok else b"unhealthy")
-                if self.path == "/metrics":
-                    return self._send(200, METRICS.render().encode())
-                if self.path == "/configz":
-                    payload = render_configz(outer.configz)
-                    return self._send(200, json.dumps(payload).encode(),
-                                      "application/json")
+                hit = debug_route(self.path, outer.healthz, outer.configz)
+                if hit is not None:
+                    return self._send(*hit[:2], hit[2])
                 self._send(404, b"not found")
 
         self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)

@@ -1,0 +1,270 @@
+"""ProcessRuntime + kubelet node server: real processes behind the kubelet.
+
+Parity target: reference pkg/kubelet/dockertools/docker_manager.go (a
+runtime that runs real workloads) and pkg/kubelet/server/server.go:237-298
+(logs/exec served on the node port). Round-4 verdict #5's done-criterion,
+verbatim: an e2e test schedules a pod, reads real logs via kubectl logs,
+kills the process, and PLEG observes + restart policy applies.
+"""
+
+import io
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
+from kubernetes_tpu.kubelet.runtime import FakeCadvisor
+from kubernetes_tpu.kubelet.server import KubeletServer
+
+
+def mk_pod(name, command, restart_policy="Always", ns="default"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.PodSpec(
+            restart_policy=restart_policy,
+            containers=[api.Container(
+                name="main", image="pause", command=command,
+                resources=api.ResourceRequirements(
+                    requests={"cpu": "100m", "memory": "64Mi"}))]))
+
+
+def wait_for(cond, timeout=20.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestProcessRuntimeUnit:
+    """Runtime alone: spawn/observe/kill/restart/logs/exec on real PIDs."""
+
+    @pytest.fixture()
+    def rt(self, tmp_path):
+        rt = ProcessRuntime(root_dir=str(tmp_path / "pods"))
+        try:
+            yield rt
+        finally:
+            rt.cleanup()
+
+    def test_spawn_observe_logs(self, rt):
+        pod = mk_pod("w", ["/bin/sh", "-c",
+                           "echo hello-from-pod; sleep 600"])
+        rt.sync_pod(pod)
+        assert rt.container_states("default/w") == {"main": "running"}
+        pid = int(rt.running()["default/w"].container_ids[0]
+                  .split("//")[1])
+        assert os.path.exists(f"/proc/{pid}")
+        wait_for(lambda: "hello-from-pod" in rt.logs("default/w", "main"),
+                 msg="log line")
+
+    def test_pause_equivalent_for_commandless_container(self, rt):
+        rt.sync_pod(mk_pod("p", None))
+        assert rt.container_states("default/p") == {"main": "running"}
+
+    def test_kill_pod_reaps_process_group(self, rt):
+        rt.sync_pod(mk_pod("k", ["/bin/sh", "-c", "sleep 600"]))
+        pid = int(rt.running()["default/k"].container_ids[0].split("//")[1])
+        rt.kill_pod("default/k")
+        wait_for(lambda: not os.path.exists(f"/proc/{pid}")
+                 or open(f"/proc/{pid}/stat").read().split()[2] == "Z",
+                 msg="process reaped")
+        assert rt.running() == {}
+
+    def test_external_kill_observed_and_restart(self, rt):
+        rt.sync_pod(mk_pod("c", ["/bin/sh", "-c", "echo run-$$; sleep 600"]))
+        pid = int(rt.running()["default/c"].container_ids[0].split("//")[1])
+        # the banner must hit the log before the kill, or .prev is empty
+        wait_for(lambda: "run-" in rt.logs("default/c", "main"),
+                 msg="first-incarnation banner")
+        os.kill(pid, signal.SIGKILL)
+        wait_for(lambda: rt.container_states("default/c")["main"] == "dead",
+                 msg="death observed")
+        rt.restart_container("default/c", "main")
+        assert rt.container_states("default/c")["main"] == "running"
+        rp = rt.running()["default/c"]
+        assert rp.restart_counts["main"] == 1
+        new_pid = int(rp.container_ids[0].split("//")[1])
+        assert new_pid != pid
+        # the previous incarnation's log survives
+        wait_for(lambda: "run-" in rt.logs("default/c", "main",
+                                           previous=True),
+                 msg="previous log")
+
+    def test_exec_runs_in_pod_context(self, rt):
+        rt.sync_pod(mk_pod("e", ["/bin/sh", "-c", "sleep 600"]))
+        rc, out = rt.exec("default/e", "main",
+                          ["/bin/sh", "-c", "echo $POD_NAME:$CONTAINER_NAME"])
+        assert rc == 0 and out.strip() == "e:main"
+        rc, _ = rt.exec("default/e", "main", ["/bin/false"])
+        assert rc == 1
+
+    def test_exec_probe_runs_real_commands(self, rt):
+        rt.sync_pod(mk_pod("pr", ["/bin/sh", "-c", "touch ready; sleep 600"]))
+        wait_for(lambda: rt.exec_probe("default/pr", "main",
+                                       ["test", "-f", "ready"]) == 0,
+                 msg="probe file")
+        assert rt.exec_probe("default/pr", "main",
+                             ["test", "-f", "missing"]) != 0
+
+
+class TestKubeletE2E:
+    """The verdict's exact scenario through the full stack."""
+
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        server = APIServer().start()
+        client = RESTClient.for_server(server)
+        rt = ProcessRuntime(root_dir=str(tmp_path / "pods"))
+        ks = KubeletServer(rt).start()
+        kl = Kubelet(client, "pnode", runtime=rt, cadvisor=FakeCadvisor(),
+                     heartbeat_period=1.0, sync_period=0.2)
+        kl.server_port = ks.port
+        kl.start()
+        try:
+            yield server, client, rt, ks, kl
+        finally:
+            kl.stop()
+            ks.stop()
+            rt.cleanup()
+            server.stop()
+
+    def _schedule(self, client, pod):
+        client.create("pods", pod)
+        client.bind(api.Binding(
+            metadata=api.ObjectMeta(name=pod.metadata.name),
+            target=api.ObjectReference(kind="Node", name="pnode")),
+            pod.metadata.namespace or "default")
+
+    def test_logs_exec_kill_restart_via_kubectl(self, stack, capsys):
+        server, client, rt, ks, kl = stack
+        from kubernetes_tpu.kubectl.cmd import main as kubectl
+        host = ["-s", f"127.0.0.1:{server.port}"]
+
+        self._schedule(client, mk_pod(
+            "web", ["/bin/sh", "-c", "echo serving-requests; sleep 600"]))
+        # kubelet picks the binding up from its watch and starts a REAL pid
+        wait_for(lambda: "default/web" in rt.running(), msg="pod running")
+        wait_for(lambda: "serving-requests" in rt.logs("default/web", "main"),
+                 msg="log output")
+        # node published its kubelet endpoint
+        wait_for(lambda: (client.get("nodes", "pnode").status.daemon_endpoints
+                          or None) is not None, msg="daemon endpoint")
+
+        # kubectl logs reads the real stream through the node server
+        assert kubectl(host + ["logs", "web"]) == 0
+        assert "serving-requests" in capsys.readouterr().out
+
+        # kubectl exec runs a real argv in the pod context
+        assert kubectl(host + ["exec", "web", "--", "/bin/sh", "-c",
+                               "echo from-exec-$POD_NAME"]) == 0
+        assert "from-exec-web" in capsys.readouterr().out
+
+        # kill the real process; PLEG observes; restartPolicy=Always respawns
+        pid = int(rt.running()["default/web"].container_ids[0].split("//")[1])
+        os.kill(pid, signal.SIGKILL)
+        wait_for(lambda: rt.running().get("default/web") is not None
+                 and rt.running()["default/web"].restart_counts.get("main", 0)
+                 >= 1, msg="PLEG-driven restart")
+        new_pid = int(rt.running()["default/web"].container_ids[0]
+                      .split("//")[1])
+        assert new_pid != pid
+        # restart visible in pod status through the API
+        wait_for(lambda: (client.get("pods", "web", "default").status
+                          .container_statuses or [None])[0] is not None
+                 and client.get("pods", "web", "default").status
+                 .container_statuses[0].restart_count >= 1,
+                 msg="restartCount in API status")
+
+    def test_restart_policy_never_goes_failed(self, stack):
+        server, client, rt, ks, kl = stack
+        self._schedule(client, mk_pod(
+            "once", ["/bin/sh", "-c", "echo did-work; exit 3"],
+            restart_policy="Never"))
+        wait_for(lambda: client.get("pods", "once", "default").status.phase
+                 == api.POD_FAILED, msg="phase=Failed")
+        # no respawn happened
+        rp = rt.running().get("default/once")
+        assert rp is None or rp.restart_counts.get("main", 0) == 0
+
+    def test_completed_command_succeeds(self, stack):
+        server, client, rt, ks, kl = stack
+        self._schedule(client, mk_pod(
+            "job1", ["/bin/sh", "-c", "echo done"],
+            restart_policy="OnFailure"))
+        wait_for(lambda: client.get("pods", "job1", "default").status.phase
+                 == api.POD_SUCCEEDED, msg="phase=Succeeded")
+
+    def test_sidecar_clean_exit_does_not_kill_worker(self, stack):
+        """OnFailure pod, one short task exiting 0 + one long worker: the
+        clean exit must NOT kill the worker or mark the pod Succeeded;
+        the pod completes only when all containers have exited."""
+        server, client, rt, ks, kl = stack
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="duo", namespace="default"),
+            spec=api.PodSpec(
+                restart_policy="OnFailure",
+                containers=[
+                    api.Container(name="task", image="pause",
+                                  command=["/bin/sh", "-c", "exit 0"]),
+                    api.Container(name="worker", image="pause",
+                                  command=["/bin/sh", "-c",
+                                           "sleep 2; exit 0"])]))
+        client.create("pods", pod)
+        client.bind(api.Binding(
+            metadata=api.ObjectMeta(name="duo"),
+            target=api.ObjectReference(kind="Node", name="pnode")), "default")
+        wait_for(lambda: "default/duo" in rt.running(), msg="pod running")
+        # the task exits immediately; the worker must survive it
+        wait_for(lambda: rt.container_states("default/duo")
+                 .get("task") == "dead", msg="task done")
+        assert rt.container_states("default/duo").get("worker") == "running"
+        assert client.get("pods", "duo", "default").status.phase \
+            != api.POD_SUCCEEDED
+        # both done -> Succeeded
+        wait_for(lambda: client.get("pods", "duo", "default").status.phase
+                 == api.POD_SUCCEEDED, msg="phase=Succeeded after both exit")
+
+    def test_exec_with_container_flag_and_blank_arg(self, stack, capsys):
+        server, client, rt, ks, kl = stack
+        from kubernetes_tpu.kubectl.cmd import main as kubectl
+        host = ["-s", f"127.0.0.1:{server.port}"]
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="two", namespace="default"),
+            spec=api.PodSpec(containers=[
+                api.Container(name="a", image="pause"),
+                api.Container(name="b", image="pause")]))
+        client.create("pods", pod)
+        client.bind(api.Binding(
+            metadata=api.ObjectMeta(name="two"),
+            target=api.ObjectReference(kind="Node", name="pnode")), "default")
+        wait_for(lambda: "default/two" in rt.running(), msg="pod running")
+        # -c selects the named container (REMAINDER must not eat the flag)
+        assert kubectl(host + ["exec", "two", "-c", "b", "--", "/bin/sh",
+                               "-c", "echo in-$CONTAINER_NAME"]) == 0
+        assert "in-b" in capsys.readouterr().out
+        # a blank argv element survives the query string round-trip
+        assert kubectl(host + ["exec", "two", "--", "printf", "[%s]",
+                               ""]) == 0
+        assert "[]" in capsys.readouterr().out
+
+    def test_bad_taillines_is_400_not_dropped_conn(self, stack):
+        server, client, rt, ks, kl = stack
+        import http.client as hc
+        self._schedule(client, mk_pod("lg", None))
+        wait_for(lambda: "default/lg" in rt.running(), msg="pod running")
+        conn = hc.HTTPConnection("127.0.0.1", ks.port, timeout=5)
+        try:
+            conn.request("GET", "/containerLogs/default/lg/main?tailLines=abc")
+            assert conn.getresponse().status == 400
+        finally:
+            conn.close()
