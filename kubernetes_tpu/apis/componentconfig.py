@@ -44,6 +44,12 @@ class APIServerConfiguration:
     max_in_flight: int = 400
     watcher_queue: int = 4096
     admission_control: str = ""  # comma-separated plugin names
+    tls_cert_file: str = ""      # secure serving when set
+    tls_private_key_file: str = ""
+    client_ca_file: str = ""     # verified client certs -> x509 identities
+    token_auth_file: str = ""    # CSV: token,user,uid[,groups]
+    authorization_mode: str = ""  # "", "RBAC", "ABAC", "AlwaysAllow"
+    authorization_policy_file: str = ""  # ABAC policy
 
 
 @dataclass

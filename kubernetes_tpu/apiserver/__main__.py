@@ -26,11 +26,23 @@ def build_config(argv=None) -> APIServerConfiguration:
                    dest="max_in_flight")
     p.add_argument("--watcher-queue", type=int, default=4096)
     p.add_argument("--admission-control", default="")
+    p.add_argument("--tls-cert-file", default="")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument("--client-ca-file", default="")
+    p.add_argument("--token-auth-file", default="")
+    p.add_argument("--authorization-mode", default="")
+    p.add_argument("--authorization-policy-file", default="")
     a = p.parse_args(argv)
     return APIServerConfiguration(
         bind_address=a.bind_address, port=a.port, data_dir=a.data_dir,
         max_in_flight=a.max_in_flight, watcher_queue=a.watcher_queue,
-        admission_control=a.admission_control)
+        admission_control=a.admission_control,
+        tls_cert_file=a.tls_cert_file,
+        tls_private_key_file=a.tls_private_key_file,
+        client_ca_file=a.client_ca_file,
+        token_auth_file=a.token_auth_file,
+        authorization_mode=a.authorization_mode,
+        authorization_policy_file=a.authorization_policy_file)
 
 
 def build_server(cfg: APIServerConfiguration) -> APIServer:
@@ -42,9 +54,39 @@ def build_server(cfg: APIServerConfiguration) -> APIServer:
         store = MemStore(watcher_queue=cfg.watcher_queue)
     admission = ([s for s in cfg.admission_control.split(",") if s]
                  or None)
+    authenticator = authorizer = None
+    if cfg.client_ca_file or cfg.token_auth_file:
+        from kubernetes_tpu.auth import (
+            TokenAuthenticator, UnionAuthenticator, X509Authenticator,
+        )
+        chain = []
+        if cfg.client_ca_file:
+            chain.append(X509Authenticator())
+        if cfg.token_auth_file:
+            with open(cfg.token_auth_file) as f:
+                chain.append(TokenAuthenticator.from_csv(f.read()))
+        authenticator = UnionAuthenticator(chain)
+    if cfg.authorization_mode == "RBAC":
+        from kubernetes_tpu.auth import RBACAuthorizer
+        authorizer = RBACAuthorizer(Registry(store))
+    elif cfg.authorization_mode == "ABAC":
+        from kubernetes_tpu.auth import ABACAuthorizer
+        with open(cfg.authorization_policy_file) as f:
+            authorizer = ABACAuthorizer.from_file_text(f.read())
+    elif cfg.authorization_mode in ("AlwaysAllow", ""):
+        authorizer = None
+    else:
+        # fail closed at startup: a typo'd mode must not silently allow all
+        raise SystemExit(
+            f"unknown --authorization-mode {cfg.authorization_mode!r} "
+            "(supported: RBAC, ABAC, AlwaysAllow)")
     server = APIServer(Registry(store), host=cfg.bind_address, port=cfg.port,
                        admission_control=admission,
-                       max_in_flight=cfg.max_in_flight)
+                       max_in_flight=cfg.max_in_flight,
+                       authenticator=authenticator, authorizer=authorizer,
+                       tls_cert_file=cfg.tls_cert_file,
+                       tls_key_file=cfg.tls_private_key_file,
+                       client_ca_file=cfg.client_ca_file)
     server.configz["apiserver"] = cfg
     return server
 
@@ -53,7 +95,8 @@ def main(argv=None) -> int:
     cfg = build_config(argv)
     server = build_server(cfg).start()
     # parseable by wrappers (localup) even with --port 0
-    print(f"apiserver listening on http://{cfg.bind_address}:{server.port}",
+    scheme = "https" if server.secure else "http"
+    print(f"apiserver listening on {scheme}://{cfg.bind_address}:{server.port}",
           flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -86,10 +86,20 @@ class APIServer:
     def __init__(self, registry: Optional[Registry] = None, host: str = "127.0.0.1",
                  port: int = 0, admission_control: Optional[list] = None,
                  authenticator=None, authorizer=None,
-                 max_in_flight: int = 400):
+                 max_in_flight: int = 400,
+                 tls_cert_file: str = "", tls_key_file: str = "",
+                 client_ca_file: str = ""):
         self.registry = registry or Registry()
         self._host = host
         self._port = port
+        # secure serving (reference genericapiserver.go:638 secure port +
+        # --tls-cert-file/--tls-private-key-file/--client-ca-file): TLS when
+        # a server keypair is given; with a client CA, verified client certs
+        # become identities via the x509 authenticator (CERT_OPTIONAL — the
+        # token/basic chain still serves certless clients)
+        self.tls_cert_file = tls_cert_file
+        self.tls_key_file = tls_key_file
+        self.client_ca_file = client_ca_file
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         # server-side flow control (reference MaxInFlightLimit,
@@ -123,8 +133,13 @@ class APIServer:
         return self._httpd.server_address[1]
 
     @property
+    def secure(self) -> bool:
+        return bool(self.tls_cert_file)
+
+    @property
     def base_url(self) -> str:
-        return f"http://{self._host}:{self.port}"
+        scheme = "https" if self.secure else "http"
+        return f"{scheme}://{self._host}:{self.port}"
 
     def start(self):
         registry = self.registry
@@ -142,6 +157,25 @@ class APIServer:
         Handler.registry = registry
         Handler.server_ref = outer
         self._httpd = Server((self._host, self._port), Handler)
+        if self.client_ca_file and not self.secure:
+            raise ValueError(
+                "--client-ca-file requires --tls-cert-file: client certs "
+                "can only be verified on a TLS listener")
+        if self.secure:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.tls_cert_file, self.tls_key_file)
+            if self.client_ca_file:
+                ctx.load_verify_locations(self.client_ca_file)
+                ctx.verify_mode = ssl.CERT_OPTIONAL
+            # handshake deferred to the per-connection worker thread: done
+            # on the listening socket it would run inside the single accept
+            # loop, letting one stalled client freeze all new connections
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
+            # and a trickling handshake must not pin a worker forever
+            Handler.timeout = 65
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="apiserver", daemon=True)
@@ -407,6 +441,18 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send_status(405, "MethodNotAllowed",
                                  f"{method} not supported here")
 
+    def _peer_cert(self):
+        """Verified TLS client certificate (ssl dict form) or None — the
+        x509 authenticator's input; the TLS handshake already chain-checked
+        it against the client CA."""
+        getpeercert = getattr(self.connection, "getpeercert", None)
+        if getpeercert is None:
+            return None
+        try:
+            return getpeercert() or None
+        except Exception:
+            return None
+
     def _auth_nonresource(self, path: str) -> bool:
         """Authn/authz for non-resource debug endpoints (/metrics, /api,
         /apis, /version). ABAC nonResourcePath and RBAC nonResourceURLs rules
@@ -417,7 +463,8 @@ class _Handler(BaseHTTPRequestHandler):
             return True
         from kubernetes_tpu.auth import AuthenticationError, AuthzAttributes
         try:
-            self._user = outer.authenticator.authenticate(self.headers)
+            self._user = outer.authenticator.authenticate(
+                self.headers, peer_cert=self._peer_cert())
         except AuthenticationError as e:
             self._send_status(401, "Unauthorized", str(e))
             return False
@@ -444,7 +491,8 @@ class _Handler(BaseHTTPRequestHandler):
             return True
         from kubernetes_tpu.auth import AuthenticationError, AuthzAttributes
         try:
-            self._user = outer.authenticator.authenticate(self.headers)
+            self._user = outer.authenticator.authenticate(
+                self.headers, peer_cert=self._peer_cert())
         except AuthenticationError as e:
             self._send_status(401, "Unauthorized", str(e))
             return False

@@ -14,6 +14,7 @@ from kubernetes_tpu.auth.authenticators import (  # noqa: F401
     TokenAuthenticator,
     UnionAuthenticator,
 )
+from kubernetes_tpu.auth.x509 import X509Authenticator  # noqa: F401
 from kubernetes_tpu.auth.authorizers import (  # noqa: F401
     ABACAuthorizer,
     AlwaysAllow,

@@ -50,7 +50,7 @@ class TokenAuthenticator:
     def from_csv(cls, text: str) -> "TokenAuthenticator":
         return cls(_parse_csv(text))
 
-    def authenticate(self, headers) -> Optional[UserInfo]:
+    def authenticate(self, headers, peer_cert=None) -> Optional[UserInfo]:
         auth = headers.get("Authorization", "")
         if not auth.startswith("Bearer "):
             return None
@@ -77,7 +77,7 @@ class BasicAuthenticator:
             by_user[info.name] = (password, info)
         return cls(by_user)
 
-    def authenticate(self, headers) -> Optional[UserInfo]:
+    def authenticate(self, headers, peer_cert=None) -> Optional[UserInfo]:
         auth = headers.get("Authorization", "")
         if not auth.startswith("Basic "):
             return None
@@ -95,7 +95,7 @@ class BasicAuthenticator:
 class AnonymousAuthenticator:
     """Always succeeds with the anonymous identity."""
 
-    def authenticate(self, headers) -> Optional[UserInfo]:
+    def authenticate(self, headers, peer_cert=None) -> Optional[UserInfo]:
         return UserInfo(name=userpkg.ANONYMOUS,
                         groups=[userpkg.ALL_UNAUTHENTICATED])
 
@@ -107,9 +107,9 @@ class UnionAuthenticator:
     def __init__(self, authenticators: List):
         self.authenticators = authenticators
 
-    def authenticate(self, headers) -> Optional[UserInfo]:
+    def authenticate(self, headers, peer_cert=None) -> Optional[UserInfo]:
         for a in self.authenticators:
-            info = a.authenticate(headers)
+            info = a.authenticate(headers, peer_cert=peer_cert)
             if info is not None:
                 return info
         raise AuthenticationError("no authenticator recognized the request")

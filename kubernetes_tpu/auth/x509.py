@@ -1,0 +1,33 @@
+"""x509 client-certificate authenticator.
+
+Parity target: reference plugin/pkg/auth/authenticator/request/x509 — the
+TLS layer (SSLContext with the client CA loaded, CERT_OPTIONAL) verifies
+the chain; this authenticator maps the ALREADY-VERIFIED peer certificate's
+subject to an identity: CN -> user name, O -> groups
+(x509.CommonNameUserConversion).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubernetes_tpu.auth.user import UserInfo
+
+
+class X509Authenticator:
+    """Identity from the TLS peer certificate (ssl.getpeercert() dict)."""
+
+    def authenticate(self, headers, peer_cert=None) -> Optional[UserInfo]:
+        if not peer_cert:
+            return None  # no client cert presented: fall through the chain
+        name = ""
+        groups = []
+        for rdn in peer_cert.get("subject", ()):
+            for key, value in rdn:
+                if key == "commonName":
+                    name = value
+                elif key == "organizationName":
+                    groups.append(value)
+        if not name:
+            return None
+        return UserInfo(name=name, uid="", groups=groups)

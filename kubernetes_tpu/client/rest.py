@@ -130,7 +130,10 @@ class RESTClient:
                  qps: float = 50.0, burst: int = 100,
                  user_agent: str = "kubernetes-tpu-client", timeout: float = 30.0,
                  bearer_token: str = "", basic_auth: Optional[tuple] = None,
-                 content_type: str = "application/json"):
+                 content_type: str = "application/json",
+                 tls: bool = False, ca_file: str = "",
+                 cert_file: str = "", key_file: str = "",
+                 insecure_skip_verify: bool = False):
         self.host = host
         self.port = port
         self.timeout = timeout
@@ -140,21 +143,60 @@ class RESTClient:
         # application/vnd.kubernetes.protobuf selects the binary wire codec
         # (reference --kube-api-content-type; kubemark defaults to it)
         self.content_type = content_type
+        # TLS client config (reference restclient.TLSClientConfig): server
+        # CA for verification plus an optional client-cert identity the
+        # apiserver's x509 authenticator maps to user/groups
+        self.tls = tls or bool(ca_file) or bool(cert_file)
+        self.ca_file = ca_file
+        self.cert_file = cert_file
+        self.key_file = key_file
+        self.insecure_skip_verify = insecure_skip_verify
         self._limiter = TokenBucket(qps, burst)
         self._local = threading.local()
 
     @classmethod
     def for_server(cls, server, **kw) -> "RESTClient":
+        if getattr(server, "secure", False):
+            kw.setdefault("tls", True)
+            # convenience skip-verify ONLY when the caller supplied no CA —
+            # a provided ca_file means they asked for verification
+            if not kw.get("ca_file"):
+                kw.setdefault("insecure_skip_verify", True)
         return cls(host="127.0.0.1", port=server.port, **kw)
 
     # --- low-level -----------------------------------------------------------
+
+    def _ssl_context(self):
+        # built once and shared: every watch reconnect would otherwise
+        # re-read the CA/cert files and lose TLS session reuse
+        ctx = getattr(self, "_ssl_ctx", None)
+        if ctx is not None:
+            return ctx
+        import ssl
+        ctx = ssl.create_default_context()
+        if self.ca_file:
+            ctx.load_verify_locations(self.ca_file)
+        if self.insecure_skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if self.cert_file:
+            ctx.load_cert_chain(self.cert_file, self.key_file or None)
+        self._ssl_ctx = ctx
+        return ctx
+
+    def _new_conn(self, timeout: float) -> http.client.HTTPConnection:
+        if self.tls:
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=timeout,
+                context=self._ssl_context())
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
 
     def _conn(self) -> http.client.HTTPConnection:
         # one keep-alive connection per thread
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = http.client.HTTPConnection(self.host, self.port,
-                                              timeout=self.timeout)
+            conn = self._new_conn(self.timeout)
             self._local.conn = conn
         return conn
 
@@ -370,7 +412,7 @@ class RESTClient:
             label_selector, field_selector, watch="true",
             resourceVersion=resource_version)
         binary = self.content_type == binary_codec.CONTENT_TYPE
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout + 35)
+        conn = self._new_conn(self.timeout + 35)
         headers = {"User-Agent": self.user_agent}
         if binary:
             headers["Accept"] = binary_codec.CONTENT_TYPE

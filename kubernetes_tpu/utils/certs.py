@@ -1,0 +1,117 @@
+"""Certificate plumbing for the secure serving stack.
+
+Parity target: the reference's cert utilities behind --tls-cert-file /
+--client-ca-file (pkg/genericapiserver, pkg/util/crypto): a minimal CA +
+issuance helper used by tests, localup, and --tls-self-signed bring-up.
+Identity convention matches the reference x509 authenticator
+(plugin/pkg/auth/authenticator/request/x509): subject CN = user name,
+subject O = group memberships.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from typing import List, Optional, Tuple
+
+
+def _crypto():
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    return x509, hashes, serialization, ec
+
+
+class CertAuthority:
+    """An in-memory CA that can issue server and client certificates."""
+
+    def __init__(self, common_name: str = "kubernetes-tpu-ca"):
+        x509, hashes, serialization, ec = _crypto()
+        self._x509 = x509
+        self._hashes = hashes
+        self._ser = serialization
+        self.key = ec.generate_private_key(ec.SECP256R1())
+        name = x509.Name([x509.NameAttribute(
+            x509.oid.NameOID.COMMON_NAME, common_name)])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        self.cert = (
+            x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(self.key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .sign(self.key, hashes.SHA256()))
+
+    # -- issuance --------------------------------------------------------------
+
+    def issue(self, common_name: str, organizations: Optional[List[str]] = None,
+              dns_names: Optional[List[str]] = None,
+              ips: Optional[List[str]] = None,
+              server: bool = False) -> Tuple[bytes, bytes]:
+        """(cert PEM, key PEM) with CN=common_name, O=organizations."""
+        x509, hashes, serialization, ec = _crypto()
+        key = ec.generate_private_key(ec.SECP256R1())
+        attrs = [x509.NameAttribute(x509.oid.NameOID.COMMON_NAME, common_name)]
+        for org in organizations or []:
+            attrs.append(x509.NameAttribute(
+                x509.oid.NameOID.ORGANIZATION_NAME, org))
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name(attrs))
+            .issuer_name(self.cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                           critical=True)
+            .add_extension(x509.ExtendedKeyUsage(
+                [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH] if server
+                else [x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]),
+                critical=False))
+        sans = [x509.DNSName(d) for d in (dns_names or [])]
+        sans += [x509.IPAddress(ipaddress.ip_address(ip))
+                 for ip in (ips or [])]
+        if sans:
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName(sans), critical=False)
+        cert = builder.sign(self.key, hashes.SHA256())
+        return (cert.public_bytes(serialization.Encoding.PEM),
+                key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.PKCS8,
+                    serialization.NoEncryption()))
+
+    def ca_pem(self) -> bytes:
+        return self.cert.public_bytes(self._ser.Encoding.PEM)
+
+    # -- file helpers ----------------------------------------------------------
+
+    def write_bundle(self, directory: str, name: str, common_name: str,
+                     organizations: Optional[List[str]] = None,
+                     server: bool = False,
+                     ips: Optional[List[str]] = None) -> dict:
+        """Issue + write {name}.crt/.key and ca.crt under directory; returns
+        the paths."""
+        os.makedirs(directory, exist_ok=True)
+        cert_pem, key_pem = self.issue(
+            common_name, organizations,
+            dns_names=["localhost"] if server else None,
+            ips=ips or (["127.0.0.1"] if server else None), server=server)
+        paths = {
+            "cert": os.path.join(directory, f"{name}.crt"),
+            "key": os.path.join(directory, f"{name}.key"),
+            "ca": os.path.join(directory, "ca.crt"),
+        }
+        with open(paths["cert"], "wb") as f:
+            f.write(cert_pem)
+        with open(paths["key"], "wb") as f:
+            f.write(key_pem)
+        with open(paths["ca"], "wb") as f:
+            f.write(self.ca_pem())
+        return paths

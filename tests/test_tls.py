@@ -1,0 +1,189 @@
+"""TLS serving + x509 client-cert authentication.
+
+Parity target: reference pkg/genericapiserver/genericapiserver.go:638
+(secure port with --tls-cert-file/--client-ca-file) and
+plugin/pkg/auth/authenticator/request/x509 (verified client cert subject
+CN -> user, O -> groups), authorized through RBAC (round-4 verdict #10).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apis import rbac
+from kubernetes_tpu.auth import (
+    RBACAuthorizer, TokenAuthenticator, UnionAuthenticator, X509Authenticator,
+)
+from kubernetes_tpu.auth.user import UserInfo
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.registry.generic import Registry
+from kubernetes_tpu.utils.certs import CertAuthority
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("pki"))
+    ca = CertAuthority()
+    server = ca.write_bundle(d, "server", "kube-apiserver", server=True)
+    alice = ca.write_bundle(d, "alice", "alice", organizations=["dev", "qa"])
+    mallory_ca = CertAuthority("evil-ca")
+    mallory = mallory_ca.write_bundle(d + "/evil", "mallory", "alice")
+    return {"ca": ca, "server": server, "alice": alice, "mallory": mallory}
+
+
+def tls_server(pki, authorizer=None, **kw):
+    return APIServer(
+        tls_cert_file=pki["server"]["cert"],
+        tls_key_file=pki["server"]["key"],
+        client_ca_file=pki["server"]["ca"],
+        authenticator=UnionAuthenticator([
+            X509Authenticator(),
+            TokenAuthenticator({"sekrit": UserInfo(name="tokenuser",
+                                                   uid="t1")}),
+        ]),
+        authorizer=authorizer, **kw).start()
+
+
+def grant_rbac(registry: Registry, subject_kind: str, subject: str):
+    """ClusterRole allowing pod ops + binding for the subject."""
+    registry.create("clusterroles", rbac.ClusterRole(
+        metadata=api.ObjectMeta(name="pod-admin"),
+        rules=[rbac.PolicyRule(verbs=["*"], resources=["pods"],
+                               api_groups=[""])]))
+    registry.create("clusterrolebindings", rbac.ClusterRoleBinding(
+        metadata=api.ObjectMeta(name="pod-admin-binding"),
+        subjects=[rbac.Subject(kind=subject_kind, name=subject)],
+        role_ref=api.ObjectReference(kind="ClusterRole",
+                                     name="pod-admin")))
+
+
+def mk_pod(name="p0"):
+    return api.Pod(metadata=api.ObjectMeta(name=name, namespace="default"),
+                   spec=api.PodSpec(containers=[
+                       api.Container(name="c", image="img")]))
+
+
+class TestTLSServing:
+    def test_https_crud_with_verified_server_cert(self, pki):
+        server = tls_server(pki)
+        try:
+            client = RESTClient(port=server.port, tls=True,
+                                ca_file=pki["server"]["ca"],
+                                cert_file=pki["alice"]["cert"],
+                                key_file=pki["alice"]["key"])
+            created = client.create("pods", mk_pod())
+            assert created.metadata.name == "p0"
+            assert client.get("pods", "p0", "default").metadata.name == "p0"
+        finally:
+            server.stop()
+
+    def test_plain_http_to_tls_port_fails(self, pki):
+        server = tls_server(pki)
+        try:
+            client = RESTClient(port=server.port)  # no TLS
+            with pytest.raises(Exception):
+                client.get("pods", "p0", "default")
+        finally:
+            server.stop()
+
+    def test_wrong_ca_rejected_by_client(self, pki):
+        server = tls_server(pki)
+        try:
+            evil = CertAuthority("other")
+            import tempfile, os
+            with tempfile.NamedTemporaryFile(delete=False) as f:
+                f.write(evil.ca_pem())
+                path = f.name
+            client = RESTClient(port=server.port, tls=True, ca_file=path)
+            with pytest.raises(Exception):
+                client.get("pods", "p0", "default")
+            os.unlink(path)
+        finally:
+            server.stop()
+
+
+class TestX509Identity:
+    def test_cert_cn_o_maps_to_user_groups_via_rbac(self, pki):
+        """alice's cert (CN=alice, O=dev,qa) authorized by an RBAC binding
+        to the 'dev' GROUP — proves both CN->user and O->groups land."""
+        registry = Registry()
+        grant_rbac(registry, "Group", "dev")
+        server = tls_server(pki, authorizer=RBACAuthorizer(registry),
+                            registry=registry)
+        try:
+            alice = RESTClient(port=server.port, tls=True,
+                               ca_file=pki["server"]["ca"],
+                               cert_file=pki["alice"]["cert"],
+                               key_file=pki["alice"]["key"])
+            assert alice.create("pods", mk_pod()).metadata.name == "p0"
+            # token identity has no binding -> 403
+            token = RESTClient(port=server.port, tls=True,
+                               ca_file=pki["server"]["ca"],
+                               bearer_token="sekrit")
+            with pytest.raises(ApiError) as ei:
+                token.get("pods", "p0", "default")
+            assert ei.value.code == 403
+            # no identity at all -> 401
+            anon = RESTClient(port=server.port, tls=True,
+                              ca_file=pki["server"]["ca"])
+            with pytest.raises(ApiError) as ei:
+                anon.get("pods", "p0", "default")
+            assert ei.value.code == 401
+        finally:
+            server.stop()
+
+    def test_cert_from_untrusted_ca_is_not_an_identity(self, pki):
+        """mallory's cert says CN=alice but is signed by an untrusted CA:
+        the TLS layer must refuse the chain — impersonation by unverified
+        cert is the attack x509 authn exists to stop."""
+        registry = Registry()
+        grant_rbac(registry, "User", "alice")
+        server = tls_server(pki, authorizer=RBACAuthorizer(registry),
+                            registry=registry)
+        try:
+            mallory = RESTClient(port=server.port, tls=True,
+                                 ca_file=pki["server"]["ca"],
+                                 cert_file=pki["mallory"]["cert"],
+                                 key_file=pki["mallory"]["key"])
+            with pytest.raises(Exception) as ei:
+                mallory.get("pods", "p0", "default")
+            # either the handshake dies or the server treats it as
+            # anonymous 401 — never a 200/403-as-alice
+            assert not isinstance(ei.value, ApiError) or ei.value.code == 401
+        finally:
+            server.stop()
+
+    def test_entrypoint_serves_https(self, pki, tmp_path):
+        """python -m kubernetes_tpu.apiserver --tls-cert-file ... serves
+        https and authenticates client certs (flag surface parity)."""
+        import subprocess, sys, time
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.apiserver",
+             "--port", "0",
+             "--tls-cert-file", pki["server"]["cert"],
+             "--tls-private-key-file", pki["server"]["key"],
+             "--client-ca-file", pki["server"]["ca"]],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()
+            assert "listening on https://" in line, line
+            port = int(line.strip().rsplit(":", 1)[1])
+            client = RESTClient(port=port, tls=True,
+                                ca_file=pki["server"]["ca"],
+                                cert_file=pki["alice"]["cert"],
+                                key_file=pki["alice"]["key"])
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    client.create("pods", mk_pod("tls-e"))
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+            assert client.get("pods", "tls-e",
+                              "default").metadata.name == "tls-e"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
