@@ -17,6 +17,42 @@ from kubernetes_tpu.api.quantity import QuantityError, parse_fraction
 _DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 _DNS1123_SUBDOMAIN = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
 _QUALIFIED_NAME = re.compile(r"^([A-Za-z0-9][-A-Za-z0-9_./]*)?[A-Za-z0-9]$")
+# label VALUES: up to 63 chars, alnum ends, -_. inside, empty allowed
+# (reference validation.IsValidLabelValue)
+_LABEL_VALUE = re.compile(r"(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?\Z")
+# port names: IANA_SVC_NAME — <=15 lowercase alnum/-, at least one letter,
+# no leading/trailing/double dash (reference validation.IsValidPortName)
+_IANA_SVC = re.compile(r"[a-z0-9]([a-z0-9-]*[a-z0-9])?\Z")
+# env var names (reference validation.IsCIdentifier)
+_C_IDENTIFIER = re.compile(r"[A-Za-z_][A-Za-z0-9_]*\Z")
+
+# reference api/validation/objectmeta TotalAnnotationSizeLimitB
+_MAX_ANNOTATION_BYTES = 256 * 1024
+
+
+def _valid_label_value(v) -> bool:
+    return (isinstance(v, str) and len(v) <= 63
+            and bool(_LABEL_VALUE.match(v)))
+
+
+def _valid_qualified_name(key: str) -> bool:
+    """Label/annotation keys: [prefix/]name with the prefix a DNS-1123
+    subdomain (<=253) and the name a qualified name (<=63)."""
+    if "/" in key:
+        prefix, _, name = key.partition("/")
+        if not prefix or len(prefix) > 253 \
+                or not _DNS1123_SUBDOMAIN.match(prefix):
+            return False
+    else:
+        name = key
+    return bool(name) and len(name) <= 63 \
+        and bool(_QUALIFIED_NAME.match(name)) and "/" not in name
+
+
+def _valid_port_name(name: str) -> bool:
+    return (len(name) <= 15 and bool(_IANA_SVC.match(name))
+            and "--" not in name
+            and any(ch.isalpha() for ch in name))
 
 
 class ValidationError(ValueError):
@@ -53,9 +89,22 @@ def validate_object_meta(meta: Optional[api.ObjectMeta], namespaced: bool,
                    f"{prefix}.namespace: must be a DNS-1123 label: {meta.namespace!r}")
     else:
         _check(errs, not meta.namespace, f"{prefix}.namespace: not allowed on cluster-scoped object")
-    for k in (meta.labels or {}):
-        _check(errs, _QUALIFIED_NAME.match(k.rsplit("/", 1)[-1]),
+    for k, v in (meta.labels or {}).items():
+        _check(errs, isinstance(k, str) and _valid_qualified_name(k),
                f"{prefix}.labels: invalid key {k!r}")
+        _check(errs, _valid_label_value(v),
+               f"{prefix}.labels[{k}]: invalid value {v!r}")
+    total = 0
+    for k, v in (meta.annotations or {}).items():
+        _check(errs, isinstance(k, str) and _valid_qualified_name(k),
+               f"{prefix}.annotations: invalid key {k!r}")
+        if not isinstance(v, str):
+            errs.append(f"{prefix}.annotations[{k}]: value must be a string")
+            continue
+        # BYTES, not characters (reference TotalAnnotationSizeLimitB)
+        total += len(str(k).encode()) + len(v.encode())
+    _check(errs, total <= _MAX_ANNOTATION_BYTES,
+           f"{prefix}.annotations: total size {total} exceeds 256KB")
 
 
 def _validate_resource_list(rl, errs, prefix):
@@ -68,28 +117,151 @@ def _validate_resource_list(rl, errs, prefix):
             errs.append(f"{prefix}.{k}: invalid quantity {v!r}")
 
 
+def _validate_probe(probe, errs, prefix):
+    if probe is None:
+        return
+    for fld in ("initial_delay_seconds", "timeout_seconds", "period_seconds",
+                "success_threshold", "failure_threshold"):
+        v = getattr(probe, fld, 0)
+        _check(errs, v is None or v >= 0,
+               f"{prefix}.{fld}: must be non-negative")
+    handlers = sum(1 for h in (probe.exec, probe.http_get, probe.tcp_socket)
+                   if h is not None)
+    _check(errs, handlers == 1,
+           f"{prefix}: exactly one handler (exec/httpGet/tcpSocket) required")
+
+
+def _validate_requests_vs_limits(c, errs, prefix):
+    """Per-resource limits must cover requests (ValidateResourceRequirements)."""
+    if not c.resources or not c.resources.limits or not c.resources.requests:
+        return
+    for k, req in c.resources.requests.items():
+        lim = c.resources.limits.get(k)
+        if lim is None:
+            continue
+        try:
+            _check(errs, parse_fraction(req) <= parse_fraction(lim),
+                   f"{prefix}.resources.requests.{k}: exceeds limit")
+        except QuantityError:
+            pass  # reported by _validate_resource_list
+
+
 def validate_pod(pod: api.Pod) -> None:
     errs: List[str] = []
     validate_object_meta(pod.metadata, True, errs)
     spec = pod.spec
     if spec is None or not spec.containers:
         errs.append("spec.containers: at least one container required")
-    else:
-        seen = set()
-        for i, c in enumerate(spec.containers):
-            p = f"spec.containers[{i}]"
-            _check(errs, bool(c.name), f"{p}.name: required")
+        if errs:
+            raise ValidationError(errs)
+        return
+    _check(errs, spec.restart_policy in ("", "Always", "OnFailure", "Never"),
+           f"spec.restartPolicy: invalid {spec.restart_policy!r}")
+    if spec.termination_grace_period_seconds is not None:
+        _check(errs, spec.termination_grace_period_seconds >= 0,
+               "spec.terminationGracePeriodSeconds: must be non-negative")
+    if spec.active_deadline_seconds is not None:
+        _check(errs, spec.active_deadline_seconds >= 1,
+               "spec.activeDeadlineSeconds: must be >= 1")
+    for k, v in (spec.node_selector or {}).items():
+        _check(errs, isinstance(k, str) and _valid_qualified_name(k),
+               f"spec.nodeSelector: invalid key {k!r}")
+        _check(errs, _valid_label_value(v),
+               f"spec.nodeSelector[{k}]: invalid value {v!r}")
+    vol_names = set()
+    for i, vol in enumerate(spec.volumes or []):
+        p = f"spec.volumes[{i}]"
+        _check(errs, bool(vol.name), f"{p}.name: required")
+        if vol.name:
+            _check(errs, len(vol.name) <= 63
+                   and _DNS1123_LABEL.match(vol.name),
+                   f"{p}.name: must be a DNS-1123 label: {vol.name!r}")
+            _check(errs, vol.name not in vol_names,
+                   f"{p}.name: duplicate {vol.name!r}")
+            vol_names.add(vol.name)
+    for i, tol in enumerate(spec.tolerations or []):
+        p = f"spec.tolerations[{i}]"
+        _check(errs, tol.operator in ("", "Exists", "Equal"),
+               f"{p}.operator: must be Exists or Equal")
+        if tol.operator == "Exists":
+            _check(errs, not tol.value,
+                   f"{p}.value: must be empty with operator Exists")
+        _check(errs, tol.effect in ("", "NoSchedule", "PreferNoSchedule"),
+               f"{p}.effect: invalid {tol.effect!r}")
+    seen = set()
+    host_ports = set()
+    for i, c in enumerate(spec.containers):
+        p = f"spec.containers[{i}]"
+        _check(errs, bool(c.name), f"{p}.name: required")
+        if c.name:
+            _check(errs, len(c.name) <= 63 and _DNS1123_LABEL.match(c.name),
+                   f"{p}.name: must be a DNS-1123 label: {c.name!r}")
             _check(errs, c.name not in seen, f"{p}.name: duplicate {c.name!r}")
             seen.add(c.name)
-            _check(errs, bool(c.image), f"{p}.image: required")
-            if c.resources:
-                _validate_resource_list(c.resources.requests, errs, f"{p}.resources.requests")
-                _validate_resource_list(c.resources.limits, errs, f"{p}.resources.limits")
-            for j, port in enumerate(c.ports or []):
-                _check(errs, 0 < port.container_port < 65536,
-                       f"{p}.ports[{j}].containerPort: out of range")
-                _check(errs, 0 <= port.host_port < 65536,
-                       f"{p}.ports[{j}].hostPort: out of range")
+        _check(errs, bool(c.image), f"{p}.image: required")
+        _check(errs, c.image_pull_policy in ("", "Always", "Never",
+                                             "IfNotPresent"),
+               f"{p}.imagePullPolicy: invalid {c.image_pull_policy!r}")
+        if c.resources:
+            _validate_resource_list(c.resources.requests, errs,
+                                    f"{p}.resources.requests")
+            _validate_resource_list(c.resources.limits, errs,
+                                    f"{p}.resources.limits")
+            _validate_requests_vs_limits(c, errs, p)
+        for j, env in enumerate(c.env or []):
+            _check(errs, bool(env.name) and _C_IDENTIFIER.match(env.name),
+                   f"{p}.env[{j}].name: must be a C identifier: "
+                   f"{env.name!r}")
+        for j, port in enumerate(c.ports or []):
+            pp = f"{p}.ports[{j}]"
+            _check(errs, 0 < port.container_port < 65536,
+                   f"{pp}.containerPort: out of range")
+            _check(errs, 0 <= port.host_port < 65536,
+                   f"{pp}.hostPort: out of range")
+            if port.name:
+                _check(errs, _valid_port_name(port.name),
+                       f"{pp}.name: invalid port name {port.name!r}")
+            _check(errs, port.protocol in ("", "TCP", "UDP"),
+                   f"{pp}.protocol: must be TCP or UDP")
+            if port.host_port:
+                key = (port.protocol or "TCP", port.host_port)
+                _check(errs, key not in host_ports,
+                       f"{pp}.hostPort: duplicate {key}")
+                host_ports.add(key)
+        _validate_probe(c.liveness_probe, errs, f"{p}.livenessProbe")
+        _validate_probe(c.readiness_probe, errs, f"{p}.readinessProbe")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_pod_update(new: api.Pod, old: api.Pod) -> None:
+    """Reference ValidatePodUpdate: the pod spec is immutable except
+    containers[*].image (same containers, same order). nodeName changes are
+    rejected separately by the registry's binding-only guard."""
+    errs: List[str] = []
+    ns, os_ = new.spec, old.spec
+    if ns is None or os_ is None:
+        if (ns is None) != (os_ is None):
+            errs.append("spec: may not be added or removed")
+        if errs:
+            raise ValidationError(errs)
+        return
+    from kubernetes_tpu.api.serialization import deep_copy
+    a, b = deep_copy(ns), deep_copy(os_)
+    # normalize the mutable fields + versioned defaults (a v2 client's
+    # decode fills restartPolicy/protocol that a v1-stored pod leaves
+    # empty — semantically equal specs must compare equal), then demand
+    # equality
+    for side in (a, b):
+        side.restart_policy = side.restart_policy or "Always"
+        for c in (side.containers or []):
+            c.image = ""
+            for port in c.ports or []:
+                port.protocol = port.protocol or "TCP"
+    b.node_name = a.node_name  # guarded by the binding-only rule instead
+    if a != b:
+        errs.append("spec: pod updates may not change fields other than "
+                    "containers[*].image")
     if errs:
         raise ValidationError(errs)
 
@@ -111,8 +283,33 @@ def validate_service(svc: api.Service) -> None:
     if spec is None or not spec.ports:
         errs.append("spec.ports: required")
     else:
+        names = set()
         for i, p in enumerate(spec.ports):
-            _check(errs, 0 < p.port < 65536, f"spec.ports[{i}].port: out of range")
+            pp = f"spec.ports[{i}]"
+            _check(errs, 0 < p.port < 65536, f"{pp}.port: out of range")
+            _check(errs, p.protocol in ("", "TCP", "UDP"),
+                   f"{pp}.protocol: must be TCP or UDP")
+            if p.name:
+                _check(errs, _valid_port_name(p.name),
+                       f"{pp}.name: invalid port name {p.name!r}")
+                _check(errs, p.name not in names,
+                       f"{pp}.name: duplicate {p.name!r}")
+                names.add(p.name)
+            elif len(spec.ports) > 1:
+                errs.append(f"{pp}.name: required when multiple ports")
+            if p.node_port:
+                _check(errs, 30000 <= p.node_port <= 32767,
+                       f"{pp}.nodePort: outside 30000-32767")
+        _check(errs, spec.session_affinity in ("", "None", "ClientIP"),
+               f"spec.sessionAffinity: invalid {spec.session_affinity!r}")
+        _check(errs, spec.type in ("", "ClusterIP", "NodePort",
+                                   "LoadBalancer"),
+               f"spec.type: invalid {spec.type!r}")
+        for k, v in (spec.selector or {}).items():
+            _check(errs, isinstance(k, str) and _valid_qualified_name(k),
+                   f"spec.selector: invalid key {k!r}")
+            _check(errs, _valid_label_value(v),
+                   f"spec.selector[{k}]: invalid value {v!r}")
     if errs:
         raise ValidationError(errs)
 

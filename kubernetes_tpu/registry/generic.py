@@ -109,6 +109,12 @@ def _pod_prepare_update(new: api.Pod, old: api.Pod):
     new_nn = new.spec.node_name if new.spec else ""
     if old_nn != new_nn:
         raise invalid("spec.nodeName: may only be set via the bindings subresource")
+    # everything else in the spec is immutable except container images
+    # (reference ValidatePodUpdate, validation.go)
+    try:
+        validation.validate_pod_update(new, old)
+    except validation.ValidationError as e:
+        raise invalid(str(e)) from None
 
 
 def _service_prepare_update(new: api.Service, old: api.Service):

@@ -70,7 +70,7 @@ class TestSlowWatcherDrop:
             # not reading the stream + fat events -> socket back-pressure ->
             # the serve loop stalls -> the store watcher overflows its bound
             for i in range(64):
-                client.create("pods", mk_pod(f"p-{i:03d}", fat=256 * 1024))
+                client.create("pods", mk_pod(f"p-{i:03d}", fat=200 * 1024))
             frames = []
             for etype, obj in stream:
                 frames.append(etype)
@@ -104,7 +104,7 @@ class TestSlowWatcherDrop:
             inf.run()
             assert inf.wait_for_sync(5)
             for i in range(40):
-                client.create("pods", mk_pod(f"q-{i:03d}", fat=256 * 1024))
+                client.create("pods", mk_pod(f"q-{i:03d}", fat=200 * 1024))
             slow.set()
             deadline = time.monotonic() + 20
             while time.monotonic() < deadline:

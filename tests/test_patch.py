@@ -25,6 +25,18 @@ def mk_pod(name="p0", ns="default", labels=None):
             api.Container(name="side", image="side:1")]))
 
 
+def mk_rc(name="rc0", ns="default"):
+    return api.ReplicationController(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.ReplicationControllerSpec(
+            replicas=1, selector={"app": "rc"},
+            template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels={"app": "rc"}),
+                spec=api.PodSpec(containers=[
+                    api.Container(name="main", image="img:1"),
+                    api.Container(name="side", image="side:1")]))))
+
+
 @pytest.fixture()
 def server():
     s = APIServer().start()
@@ -62,12 +74,16 @@ class TestStrategicPatch:
         assert by_name == {"main": "img:2", "side": "side:1"}
 
     def test_dollar_patch_delete_removes_element(self, client):
-        client.create("pods", mk_pod())
+        # pod specs are immutable (ValidatePodUpdate), so the list-element
+        # delete directive is exercised on an RC's pod template
+        client.create("replicationcontrollers", mk_rc())
         got = client.patch(
-            "pods", "p0",
-            {"spec": {"containers": [{"name": "side", "$patch": "delete"}]}},
+            "replicationcontrollers", "rc0",
+            {"spec": {"template": {"spec": {"containers": [
+                {"name": "side", "$patch": "delete"}]}}}},
             "default")
-        assert [c.name for c in got.spec.containers] == ["main"]
+        assert [c.name
+                for c in got.spec.template.spec.containers] == ["main"]
 
     def test_status_subresource_patch(self, client):
         client.create("pods", mk_pod())
@@ -139,12 +155,14 @@ class TestStrategicPatch:
 
 class TestMergePatch:
     def test_lists_replace_wholesale(self, client):
-        client.create("pods", mk_pod())
+        client.create("replicationcontrollers", mk_rc())
         got = client.patch(
-            "pods", "p0",
-            {"spec": {"containers": [{"name": "only", "image": "o:1"}]}},
+            "replicationcontrollers", "rc0",
+            {"spec": {"template": {"spec": {"containers": [
+                {"name": "only", "image": "o:1"}]}}}},
             "default", patch_type=RESTClient.MERGE_PATCH)
-        assert [c.name for c in got.spec.containers] == ["only"]
+        assert [c.name
+                for c in got.spec.template.spec.containers] == ["only"]
 
     def test_null_deletes_key(self, client):
         client.create("pods", mk_pod(labels={"a": "1"}))

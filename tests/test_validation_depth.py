@@ -1,0 +1,331 @@
+"""Table-driven negative validation tests.
+
+Parity target: reference pkg/api/validation/validation.go (name formats,
+label/annotation rules, port ranges and names, probe invariants, pod-update
+immutability, service port/type rules) — round-4 verdict #9: the apiserver
+must reject what the reference rejects.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.validation import (
+    ValidationError, validate_node, validate_pod, validate_pod_update,
+    validate_service,
+)
+from kubernetes_tpu.api.serialization import deep_copy
+
+
+def base_pod(**spec_kw):
+    return api.Pod(
+        metadata=api.ObjectMeta(name="p", namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")],
+                         **spec_kw))
+
+
+def port(**kw):
+    d = dict(container_port=80)
+    d.update(kw)
+    return api.ContainerPort(**d)
+
+
+# (description, mutate(pod), expected error fragment)
+BAD_PODS = [
+    ("uppercase name",
+     lambda p: setattr(p.metadata, "name", "Upper"), "DNS-1123"),
+    ("name too long",
+     lambda p: setattr(p.metadata, "name", "a" * 254), "DNS-1123"),
+    ("name with underscore",
+     lambda p: setattr(p.metadata, "name", "a_b"), "DNS-1123"),
+    ("namespace not a label",
+     lambda p: setattr(p.metadata, "namespace", "a.b"), "DNS-1123 label"),
+    ("label key bad prefix",
+     lambda p: setattr(p.metadata, "labels", {"-bad-/x": "1"}),
+     "invalid key"),
+    ("label key empty name part",
+     lambda p: setattr(p.metadata, "labels", {"example.com/": "1"}),
+     "invalid key"),
+    ("label value too long",
+     lambda p: setattr(p.metadata, "labels", {"k": "v" * 64}),
+     "invalid value"),
+    ("label value bad chars",
+     lambda p: setattr(p.metadata, "labels", {"k": "no spaces"}),
+     "invalid value"),
+    ("annotation key invalid",
+     lambda p: setattr(p.metadata, "annotations", {"bad key": "v"}),
+     "invalid key"),
+    ("annotations too large",
+     lambda p: setattr(p.metadata, "annotations", {"k": "v" * (257 * 1024)}),
+     "256KB"),
+    ("bad restartPolicy",
+     lambda p: setattr(p.spec, "restart_policy", "Sometimes"),
+     "restartPolicy"),
+    ("negative grace period",
+     lambda p: setattr(p.spec, "termination_grace_period_seconds", -1),
+     "terminationGracePeriodSeconds"),
+    ("zero active deadline",
+     lambda p: setattr(p.spec, "active_deadline_seconds", 0),
+     "activeDeadlineSeconds"),
+    ("bad nodeSelector key",
+     lambda p: setattr(p.spec, "node_selector", {"bad key": "v"}),
+     "nodeSelector"),
+    ("container name uppercase",
+     lambda p: setattr(p.spec.containers[0], "name", "Main"), "DNS-1123"),
+    ("duplicate container names",
+     lambda p: setattr(p.spec, "containers",
+                       [api.Container(name="c", image="i"),
+                        api.Container(name="c", image="j")]), "duplicate"),
+    ("missing image",
+     lambda p: setattr(p.spec.containers[0], "image", ""), "image"),
+    ("bad imagePullPolicy",
+     lambda p: setattr(p.spec.containers[0], "image_pull_policy", "Maybe"),
+     "imagePullPolicy"),
+    ("negative cpu request",
+     lambda p: setattr(p.spec.containers[0], "resources",
+                       api.ResourceRequirements(requests={"cpu": "-100m"})),
+     "non-negative"),
+    ("garbage memory quantity",
+     lambda p: setattr(p.spec.containers[0], "resources",
+                       api.ResourceRequirements(requests={"memory": "1Zi?"})),
+     "invalid quantity"),
+    ("request exceeds limit",
+     lambda p: setattr(p.spec.containers[0], "resources",
+                       api.ResourceRequirements(requests={"cpu": "2"},
+                                                limits={"cpu": "1"})),
+     "exceeds limit"),
+    ("containerPort zero",
+     lambda p: setattr(p.spec.containers[0], "ports",
+                       [port(container_port=0)]), "out of range"),
+    ("containerPort too big",
+     lambda p: setattr(p.spec.containers[0], "ports",
+                       [port(container_port=70000)]), "out of range"),
+    ("hostPort too big",
+     lambda p: setattr(p.spec.containers[0], "ports",
+                       [port(host_port=70000)]), "out of range"),
+    ("port name too long",
+     lambda p: setattr(p.spec.containers[0], "ports",
+                       [port(name="averyveryloooongname")]), "port name"),
+    ("port name all digits",
+     lambda p: setattr(p.spec.containers[0], "ports",
+                       [port(name="1234")]), "port name"),
+    ("port name double dash",
+     lambda p: setattr(p.spec.containers[0], "ports",
+                       [port(name="a--b")]), "port name"),
+    ("bad protocol",
+     lambda p: setattr(p.spec.containers[0], "ports",
+                       [port(protocol="SCTP")]), "protocol"),
+    ("duplicate hostPort",
+     lambda p: setattr(p.spec.containers[0], "ports",
+                       [port(host_port=8080), port(container_port=81,
+                                                   host_port=8080)]),
+     "duplicate"),
+    ("env name not C identifier",
+     lambda p: setattr(p.spec.containers[0], "env",
+                       [api.EnvVar(name="1BAD", value="x")]),
+     "C identifier"),
+    ("volume missing name",
+     lambda p: setattr(p.spec, "volumes", [api.Volume(name="")]),
+     "name: required"),
+    ("duplicate volume names",
+     lambda p: setattr(p.spec, "volumes",
+                       [api.Volume(name="v",
+                                   empty_dir=api.EmptyDirVolumeSource()),
+                        api.Volume(name="v",
+                                   empty_dir=api.EmptyDirVolumeSource())]),
+     "duplicate"),
+    ("toleration bad operator",
+     lambda p: setattr(p.spec, "tolerations",
+                       [api.Toleration(key="k", operator="Like")]),
+     "operator"),
+    ("toleration Exists with value",
+     lambda p: setattr(p.spec, "tolerations",
+                       [api.Toleration(key="k", operator="Exists",
+                                       value="v")]),
+     "must be empty"),
+    ("probe without handler",
+     lambda p: setattr(p.spec.containers[0], "liveness_probe", api.Probe()),
+     "exactly one handler"),
+    ("probe with two handlers",
+     lambda p: setattr(p.spec.containers[0], "liveness_probe",
+                       api.Probe(exec=api.ExecAction(command=["x"]),
+                                 tcp_socket=api.TCPSocketAction(port=1))),
+     "exactly one handler"),
+    ("probe negative threshold",
+     lambda p: setattr(p.spec.containers[0], "readiness_probe",
+                       api.Probe(tcp_socket=api.TCPSocketAction(port=1),
+                                 failure_threshold=-1)),
+     "non-negative"),
+]
+
+
+@pytest.mark.parametrize("desc,mutate,fragment",
+                         BAD_PODS, ids=[b[0] for b in BAD_PODS])
+def test_pod_rejected(desc, mutate, fragment):
+    pod = base_pod()
+    mutate(pod)
+    with pytest.raises(ValidationError) as ei:
+        validate_pod(pod)
+    assert fragment in str(ei.value), f"{desc}: {ei.value}"
+
+
+def test_good_pod_passes():
+    pod = base_pod(
+        restart_policy="OnFailure",
+        node_selector={"kubernetes.io/hostname": "n1"},
+        volumes=[api.Volume(name="data",
+                            empty_dir=api.EmptyDirVolumeSource())],
+        tolerations=[api.Toleration(key="k", operator="Exists")])
+    pod.metadata.labels = {"app": "web", "example.com/tier": "frontend"}
+    pod.metadata.annotations = {"kubectl.kubernetes.io/last-applied": "{}"}
+    pod.spec.containers[0].ports = [port(name="http", host_port=8080)]
+    pod.spec.containers[0].env = [api.EnvVar(name="MODE", value="fast")]
+    pod.spec.containers[0].liveness_probe = api.Probe(
+        tcp_socket=api.TCPSocketAction(port=80))
+    validate_pod(pod)  # no raise
+
+
+BAD_SERVICES = [
+    ("port zero", lambda s: setattr(s.spec.ports[0], "port", 0),
+     "out of range"),
+    ("bad protocol", lambda s: setattr(s.spec.ports[0], "protocol", "ICMP"),
+     "protocol"),
+    ("nodePort out of range",
+     lambda s: setattr(s.spec.ports[0], "node_port", 40000), "30000-32767"),
+    ("multi-port unnamed",
+     lambda s: setattr(s.spec, "ports",
+                       [api.ServicePort(port=80),
+                        api.ServicePort(port=81)]), "name: required"),
+    ("duplicate port names",
+     lambda s: setattr(s.spec, "ports",
+                       [api.ServicePort(port=80, name="web"),
+                        api.ServicePort(port=81, name="web")]), "duplicate"),
+    ("bad sessionAffinity",
+     lambda s: setattr(s.spec, "session_affinity", "Sticky"),
+     "sessionAffinity"),
+    ("bad type", lambda s: setattr(s.spec, "type", "External"), "type"),
+    ("bad selector value",
+     lambda s: setattr(s.spec, "selector", {"app": "has space"}),
+     "invalid value"),
+]
+
+
+@pytest.mark.parametrize("desc,mutate,fragment",
+                         BAD_SERVICES, ids=[b[0] for b in BAD_SERVICES])
+def test_service_rejected(desc, mutate, fragment):
+    svc = api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                      spec=api.ServiceSpec(
+                          ports=[api.ServicePort(port=80)]))
+    mutate(svc)
+    with pytest.raises(ValidationError) as ei:
+        validate_service(svc)
+    assert fragment in str(ei.value), f"{desc}: {ei.value}"
+
+
+class TestPodUpdateImmutability:
+    def test_image_change_allowed(self):
+        old = base_pod()
+        new = deep_copy(old)
+        new.spec.containers[0].image = "i:v2"
+        validate_pod_update(new, old)  # no raise
+
+    def test_command_change_rejected(self):
+        old = base_pod()
+        new = deep_copy(old)
+        new.spec.containers[0].command = ["new"]
+        with pytest.raises(ValidationError):
+            validate_pod_update(new, old)
+
+    def test_resource_change_rejected(self):
+        old = base_pod()
+        new = deep_copy(old)
+        new.spec.containers[0].resources = api.ResourceRequirements(
+            requests={"cpu": "2"})
+        with pytest.raises(ValidationError):
+            validate_pod_update(new, old)
+
+    def test_container_addition_rejected(self):
+        old = base_pod()
+        new = deep_copy(old)
+        new.spec.containers.append(api.Container(name="d", image="j"))
+        with pytest.raises(ValidationError):
+            validate_pod_update(new, old)
+
+    def test_restart_policy_change_rejected(self):
+        old = base_pod()
+        new = deep_copy(old)
+        new.spec.restart_policy = "Never"
+        with pytest.raises(ValidationError):
+            validate_pod_update(new, old)
+
+    def test_served_through_apiserver(self):
+        """The registry enforces immutability on PUT; labels stay mutable."""
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client import RESTClient
+        from kubernetes_tpu.client.rest import ApiError
+        server = APIServer().start()
+        try:
+            client = RESTClient.for_server(server)
+            created = client.create("pods", base_pod())
+            mutated = deep_copy(created)
+            mutated.spec.restart_policy = "Never"
+            with pytest.raises(ApiError) as ei:
+                client.update("pods", mutated)
+            assert ei.value.code == 422
+            relabel = deep_copy(created)
+            relabel.metadata.labels = {"new": "label"}
+            assert client.update("pods", relabel).metadata.labels == {
+                "new": "label"}
+            reimage = client.get("pods", "p", "default")
+            reimage.spec.containers[0].image = "i:v2"
+            assert client.update(
+                "pods", reimage).spec.containers[0].image == "i:v2"
+        finally:
+            server.stop()
+
+
+def test_node_capacity_validated():
+    node = api.Node(metadata=api.ObjectMeta(name="n"),
+                    status=api.NodeStatus(capacity={"cpu": "-4"}))
+    with pytest.raises(ValidationError):
+        validate_node(node)
+
+
+class TestHostileInputs:
+    """Review-findings regressions: crashy/evasive inputs must 422, not 500."""
+
+    def test_non_string_label_value_rejected_not_crash(self):
+        pod = base_pod()
+        pod.metadata.labels = {"version": 2}
+        with pytest.raises(ValidationError):
+            validate_pod(pod)
+
+    def test_non_string_annotation_value_rejected(self):
+        pod = base_pod()
+        pod.metadata.annotations = {"k": ["not", "a", "string"]}
+        with pytest.raises(ValidationError):
+            validate_pod(pod)
+
+    def test_trailing_newline_rejected(self):
+        for mutate in (
+                lambda p: setattr(p.metadata, "labels", {"k": "v\n"}),
+                lambda p: setattr(p.spec.containers[0], "env",
+                                  [api.EnvVar(name="FOO\n", value="x")]),
+                lambda p: setattr(p.spec.containers[0], "ports",
+                                  [port(name="http\n")])):
+            pod = base_pod()
+            mutate(pod)
+            with pytest.raises(ValidationError):
+                validate_pod(pod)
+
+    def test_annotation_limit_counts_bytes(self):
+        pod = base_pod()
+        # 100k euro signs = 300KB utf-8 but only 100k characters
+        pod.metadata.annotations = {"k": "€" * (100 * 1024)}
+        with pytest.raises(ValidationError) as ei:
+            validate_pod(pod)
+        assert "256KB" in str(ei.value)
+
+    def test_bad_node_selector_value(self):
+        pod = base_pod(node_selector={"zone": "us east!"})
+        with pytest.raises(ValidationError):
+            validate_pod(pod)
