@@ -41,6 +41,8 @@ import os
 import sys
 import time
 
+_T0 = time.perf_counter()  # module-load mark for the restart probe
+
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 N_NODES = int(os.environ.get("BENCH_NODES", 5000))
@@ -252,6 +254,20 @@ def run_with_timeout(fn, seconds, stage):
     return box["value"]
 
 
+def _enable_cache():
+    """Persistent XLA compilation cache: a restarted scheduler (or the
+    restart probe below) reuses the compiled scan instead of re-paying the
+    ~30s cold compile (round-4 verdict #4)."""
+    try:
+        from kubernetes_tpu.utils.platform import (
+            enable_persistent_compilation_cache,
+        )
+        return enable_persistent_compilation_cache()
+    except Exception as e:  # cache is an optimization, never a blocker
+        print(f"bench: compilation cache unavailable: {e}", file=sys.stderr)
+        return ""
+
+
 def init_backend(max_tries=3):
     """Initialize the jax backend; fall back to CPU (fresh process) if the
     TPU errors persistently or hangs."""
@@ -259,9 +275,11 @@ def init_backend(max_tries=3):
         import jax
         jax.config.update("jax_platforms", "cpu")
         _clear_backends()
+        _enable_cache()
         return jax, jax.devices(), os.environ.get("BENCH_TPU_ERR", "forced")
 
     import jax
+    _enable_cache()
 
     last_err = None
     for attempt in range(max_tries):
@@ -437,6 +455,61 @@ def run_e2e(n_nodes: int, n_pods: int) -> dict:
         server.stop()
 
 
+def restart_probe() -> None:
+    """Fresh-process cold start against the persistent compilation cache:
+    module load -> backend -> tensorize -> upload -> (cached) compile ->
+    first full schedule. Prints one JSON line the parent embeds as
+    detail.restart (round-4 verdict #4: done = < 10s)."""
+    try:
+        jax, devs, backend_err = init_backend()
+        from kubernetes_tpu.ops.kernel import (
+            Weights, _schedule_jit, features_of,
+        )
+        from kubernetes_tpu.ops.tensorize import Tensorizer
+        from kubernetes_tpu.scheduler.batch import (
+            ListServiceLister, make_plugin_args,
+        )
+        import jax.numpy as jnp
+        import numpy as np
+
+        nodes, existing, pending, services = build_cluster()
+        args = make_plugin_args(nodes,
+                                service_lister=ListServiceLister(services))
+        ct = Tensorizer(plugin_args=args).build(nodes, existing, pending)
+        arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
+        t_pre = time.perf_counter()
+        out = np.asarray(_schedule_jit(arrays, ct.n_zones, Weights(),
+                                       features_of(ct)))
+        t_done = time.perf_counter()
+        print(json.dumps({
+            "restart_to_first_schedule_seconds": round(t_done - _T0, 1),
+            "compile_plus_run_seconds": round(t_done - t_pre, 1),
+            "scheduled": int((out[: ct.n_real_pods] >= 0).sum()),
+            "device": str(devs[0]),
+        }))
+    except Exception as e:
+        print(json.dumps({"error": repr(e)}))
+
+
+def run_restart_probe() -> dict:
+    """Spawn the restart probe as a genuinely fresh interpreter."""
+    import subprocess
+    env = dict(os.environ)
+    env["BENCH_RESTART_PROBE"] = "1"
+    env["BENCH_E2E"] = "0"
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=600)
+        for line in reversed(res.stdout.decode().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+    except Exception as e:
+        return {"error": repr(e)}
+    return {"error": "no probe output"}
+
+
 def main():
     t_start = time.perf_counter()
     try:
@@ -554,6 +627,10 @@ def main():
         except Exception as e:
             e2e = {"error": repr(e)}
 
+    restart = None
+    if os.environ.get("BENCH_RESTART", "1") != "0":
+        restart = run_restart_probe()
+
     # correctness guard: no node overcommitted on cpu or pod slots
     # (existing bound pods count toward both caps — 100m each)
     assign = res[res >= 0]
@@ -589,6 +666,8 @@ def main():
     }
     if e2e is not None:
         result["detail"]["e2e"] = e2e
+    if restart is not None:
+        result["detail"]["restart"] = restart
     if suspect:
         result["detail"]["estimator_notes"] = suspect
     if backend_err is not None:
@@ -597,4 +676,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_RESTART_PROBE"):
+        restart_probe()
+    else:
+        main()

@@ -14,9 +14,9 @@ from typing import List, Optional
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.quantity import QuantityError, parse_fraction
 
-_DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
-_DNS1123_SUBDOMAIN = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
-_QUALIFIED_NAME = re.compile(r"^([A-Za-z0-9][-A-Za-z0-9_./]*)?[A-Za-z0-9]$")
+_DNS1123_LABEL = re.compile(r"[a-z0-9]([-a-z0-9]*[a-z0-9])?\Z")
+_DNS1123_SUBDOMAIN = re.compile(r"[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*\Z")
+_QUALIFIED_NAME = re.compile(r"([A-Za-z0-9][-A-Za-z0-9_./]*)?[A-Za-z0-9]\Z")
 # label VALUES: up to 63 chars, alnum ends, -_. inside, empty allowed
 # (reference validation.IsValidLabelValue)
 _LABEL_VALUE = re.compile(r"(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?\Z")
@@ -117,13 +117,17 @@ def _validate_resource_list(rl, errs, prefix):
             errs.append(f"{prefix}.{k}: invalid quantity {v!r}")
 
 
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
 def _validate_probe(probe, errs, prefix):
     if probe is None:
         return
     for fld in ("initial_delay_seconds", "timeout_seconds", "period_seconds",
                 "success_threshold", "failure_threshold"):
         v = getattr(probe, fld, 0)
-        _check(errs, v is None or v >= 0,
+        _check(errs, v is None or (_is_num(v) and v >= 0),
                f"{prefix}.{fld}: must be non-negative")
     handlers = sum(1 for h in (probe.exec, probe.http_get, probe.tcp_socket)
                    if h is not None)
@@ -158,10 +162,12 @@ def validate_pod(pod: api.Pod) -> None:
     _check(errs, spec.restart_policy in ("", "Always", "OnFailure", "Never"),
            f"spec.restartPolicy: invalid {spec.restart_policy!r}")
     if spec.termination_grace_period_seconds is not None:
-        _check(errs, spec.termination_grace_period_seconds >= 0,
+        _check(errs, _is_num(spec.termination_grace_period_seconds)
+               and spec.termination_grace_period_seconds >= 0,
                "spec.terminationGracePeriodSeconds: must be non-negative")
     if spec.active_deadline_seconds is not None:
-        _check(errs, spec.active_deadline_seconds >= 1,
+        _check(errs, _is_num(spec.active_deadline_seconds)
+               and spec.active_deadline_seconds >= 1,
                "spec.activeDeadlineSeconds: must be >= 1")
     for k, v in (spec.node_selector or {}).items():
         _check(errs, isinstance(k, str) and _valid_qualified_name(k),
@@ -209,14 +215,17 @@ def validate_pod(pod: api.Pod) -> None:
                                     f"{p}.resources.limits")
             _validate_requests_vs_limits(c, errs, p)
         for j, env in enumerate(c.env or []):
-            _check(errs, bool(env.name) and _C_IDENTIFIER.match(env.name),
+            _check(errs, isinstance(env.name, str) and bool(env.name)
+                   and _C_IDENTIFIER.match(env.name),
                    f"{p}.env[{j}].name: must be a C identifier: "
                    f"{env.name!r}")
         for j, port in enumerate(c.ports or []):
             pp = f"{p}.ports[{j}]"
-            _check(errs, 0 < port.container_port < 65536,
+            _check(errs, _is_num(port.container_port)
+                   and 0 < port.container_port < 65536,
                    f"{pp}.containerPort: out of range")
-            _check(errs, 0 <= port.host_port < 65536,
+            _check(errs, _is_num(port.host_port)
+                   and 0 <= port.host_port < 65536,
                    f"{pp}.hostPort: out of range")
             if port.name:
                 _check(errs, _valid_port_name(port.name),
@@ -286,7 +295,8 @@ def validate_service(svc: api.Service) -> None:
         names = set()
         for i, p in enumerate(spec.ports):
             pp = f"spec.ports[{i}]"
-            _check(errs, 0 < p.port < 65536, f"{pp}.port: out of range")
+            _check(errs, _is_num(p.port) and 0 < p.port < 65536,
+                   f"{pp}.port: out of range")
             _check(errs, p.protocol in ("", "TCP", "UDP"),
                    f"{pp}.protocol: must be TCP or UDP")
             if p.name:
@@ -298,7 +308,8 @@ def validate_service(svc: api.Service) -> None:
             elif len(spec.ports) > 1:
                 errs.append(f"{pp}.name: required when multiple ports")
             if p.node_port:
-                _check(errs, 30000 <= p.node_port <= 32767,
+                _check(errs, _is_num(p.node_port)
+                       and 30000 <= p.node_port <= 32767,
                        f"{pp}.nodePort: outside 30000-32767")
         _check(errs, spec.session_affinity in ("", "None", "ClientIP"),
                f"spec.sessionAffinity: invalid {spec.session_affinity!r}")

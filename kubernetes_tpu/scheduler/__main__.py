@@ -60,6 +60,16 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, client):
             policy = json.load(f)
         sched = factory.create_from_policy(policy)
     elif cfg.tpu_backend:
+        # warm-start discipline: the persistent compilation cache makes a
+        # restarted scheduler's first compile a disk load, not a ~30s XLA
+        # run (the batch bucketing pins shapes, so the key is stable)
+        from kubernetes_tpu.utils.platform import (
+            enable_persistent_compilation_cache,
+        )
+        try:
+            enable_persistent_compilation_cache()
+        except Exception:
+            pass  # cache is an optimization, never a startup blocker
         sched = factory.create_batch_from_provider(
             cfg.algorithm_provider, batch_size=cfg.batch_size)
     else:

@@ -19,6 +19,28 @@ def clear_backends_compat():
     clear_backends()
 
 
+def enable_persistent_compilation_cache(path: str = "") -> str:
+    """Point XLA's persistent compilation cache at a durable directory so a
+    scheduler restart reuses the compiled 30k-step scan instead of paying
+    the ~30s cold compile again (round-4 verdict #4: restart-to-first-
+    binding must be seconds, not the compile time).
+
+    The cache key includes program HLO + compile options + backend, so a
+    kernel/feature/shape change misses cleanly. Returns the directory."""
+    import jax
+
+    cache_dir = (path or os.environ.get("KTPU_XLA_CACHE")
+                 or os.path.join(os.path.expanduser("~"), ".cache",
+                                 "kubernetes-tpu-xla"))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_enable_compilation_cache", True)
+    # the scan kernel is the whole point: cache anything non-trivial
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir
+
+
 def force_cpu(device_count: int = 0):
     """Pin jax to the host CPU platform, optionally with N virtual devices.
     Safe to call before or after jax's first import; must run before the

@@ -329,3 +329,32 @@ class TestHostileInputs:
         pod = base_pod(node_selector={"zone": "us east!"})
         with pytest.raises(ValidationError):
             validate_pod(pod)
+
+    def test_newline_in_name_and_keys_rejected(self):
+        for mutate in (
+                lambda p: setattr(p.metadata, "name", "p\n"),
+                lambda p: setattr(p.metadata, "labels", {"k\n": "v"}),
+                lambda p: setattr(p.metadata, "annotations", {"k\n": "v"}),
+                lambda p: setattr(p.spec, "node_selector", {"k\n": "v"})):
+            pod = base_pod()
+            mutate(pod)
+            with pytest.raises(ValidationError):
+                validate_pod(pod)
+
+    def test_non_numeric_fields_422_not_500(self):
+        for mutate in (
+                lambda p: setattr(p.spec, "termination_grace_period_seconds",
+                                  "abc"),
+                lambda p: setattr(p.spec, "active_deadline_seconds", "zzz"),
+                lambda p: setattr(p.spec.containers[0], "ports",
+                                  [port(container_port="80")]),
+                lambda p: setattr(p.spec.containers[0], "liveness_probe",
+                                  api.Probe(
+                                      tcp_socket=api.TCPSocketAction(port=1),
+                                      failure_threshold="3")),
+                lambda p: setattr(p.spec.containers[0], "env",
+                                  [api.EnvVar(name=123, value="x")])):
+            pod = base_pod()
+            mutate(pod)
+            with pytest.raises(ValidationError):
+                validate_pod(pod)
