@@ -1,0 +1,128 @@
+"""Shared feature-dense cluster builder for the multichip proofs.
+
+One batch shape used by BOTH the driver's dryrun_multichip and the in-suite
+sharded-equivalence tests (tests/test_multichip.py), so the layout the
+driver validates is exactly the layout the tests prove binding-identical.
+Exercises every optional scan carry: node selectors, taints/tolerations,
+hard/preferred inter-pod (anti-)affinity, EBS+GCE volumes, host ports, and
+— with_existing — the static symmetry (sym_dom0) and reverse-score
+(te_dom0) tables owned by already-bound pods.
+
+Import-safe: no device access, no platform mutation at import time.
+"""
+
+from __future__ import annotations
+
+
+def feature_batch(n_nodes=48, n_pods=32, with_existing=False):
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.ops.tensorize import Tensorizer
+    from kubernetes_tpu.scheduler.batch import ListServiceLister, make_plugin_args
+
+    nodes = []
+    for i in range(n_nodes):
+        labels = {api.LABEL_HOSTNAME: f"n{i}", api.LABEL_ZONE: f"z{i % 4}"}
+        if i % 3 == 0:
+            labels["disk"] = "ssd"
+        nodes.append(api.Node(
+            metadata=api.ObjectMeta(name=f"n{i}", labels=labels),
+            spec=api.NodeSpec(taints=(
+                [api.Taint(key="ded", value="x", effect="NoSchedule")]
+                if i % 8 == 0 else None)),
+            status=api.NodeStatus(
+                allocatable={"cpu": "4", "memory": "16Gi", "pods": "32"},
+                conditions=[api.NodeCondition(type="Ready", status="True")])))
+    svc = api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                      spec=api.ServiceSpec(selector={"app": "web"},
+                                           ports=[api.ServicePort(port=80)]))
+    pending = []
+    for i in range(n_pods):
+        labels = {"app": "web" if i % 2 else "db", "uniq": f"u{i}"}
+        # exercise the full kernel carry surface (interpod term tables +
+        # volume columns) without making any pod unschedulable: anti/affinity
+        # terms select each pod's unique label, volumes are per-pod unique
+        affinity = None
+        volumes = None
+        if i % 6 == 1:
+            affinity = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(
+                            match_labels={"uniq": f"u{i}"}),
+                        topology_key=api.LABEL_ZONE)]))
+        elif i % 6 == 3:
+            affinity = api.Affinity(pod_affinity=api.PodAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(
+                            match_labels={"uniq": f"u{i}"}),
+                        topology_key=api.LABEL_ZONE)],
+                preferred_during_scheduling_ignored_during_execution=[
+                    api.WeightedPodAffinityTerm(
+                        weight=10,
+                        pod_affinity_term=api.PodAffinityTerm(
+                            label_selector=api.LabelSelector(
+                                match_labels={"app": "web"}),
+                            topology_key=api.LABEL_ZONE))]))
+        elif i % 12 == 5:
+            volumes = [api.Volume(
+                name=f"v{i}", aws_elastic_block_store=
+                api.AWSElasticBlockStoreVolumeSource(volume_id=f"vol-{i}"))]
+        elif i % 12 == 11:
+            volumes = [api.Volume(
+                name=f"v{i}", gce_persistent_disk=
+                api.GCEPersistentDiskVolumeSource(pd_name=f"pd-{i}",
+                                                  read_only=True))]
+        pending.append(api.Pod(
+            metadata=api.ObjectMeta(name=f"p{i}", namespace="default",
+                                    labels=labels),
+            spec=api.PodSpec(
+                node_selector={"disk": "ssd"} if i % 5 == 0 else None,
+                tolerations=([api.Toleration(key="ded", operator="Exists")]
+                             if i % 8 == 0 else None),
+                affinity=affinity, volumes=volumes,
+                containers=[api.Container(
+                    name="c", image="pause",
+                    # unique host port per pod: traces the port-occupancy
+                    # carry without ever conflicting
+                    ports=([api.ContainerPort(container_port=8080,
+                                              host_port=9000 + i)]
+                           if i % 6 == 2 else None),
+                    resources=api.ResourceRequirements(
+                        requests={"cpu": "250m", "memory": "256Mi"}))])))
+    # existing bound pods owning anti + preferred/hard terms: traces the
+    # static symmetry (sym_dom0) and reverse-score (te_dom0) carries too,
+    # so the sharded proof covers the FULL default-provider surface
+    existing = []
+    if with_existing:
+        for i in range(max(n_nodes // 8, 4)):
+            kw = {}
+            if i % 3 == 0:
+                kw["affinity"] = api.Affinity(
+                    pod_anti_affinity=api.PodAntiAffinity(
+                        required_during_scheduling_ignored_during_execution=[
+                            api.PodAffinityTerm(
+                                label_selector=api.LabelSelector(
+                                    match_labels={"sym": f"s{i // 3 % 3}"}),
+                                topology_key=api.LABEL_HOSTNAME)]))
+            elif i % 3 == 1:
+                kw["affinity"] = api.Affinity(pod_affinity=api.PodAffinity(
+                    preferred_during_scheduling_ignored_during_execution=[
+                        api.WeightedPodAffinityTerm(
+                            weight=4,
+                            pod_affinity_term=api.PodAffinityTerm(
+                                label_selector=api.LabelSelector(
+                                    match_labels={"app": "web"}),
+                                topology_key=api.LABEL_ZONE))]))
+            existing.append(api.Pod(
+                metadata=api.ObjectMeta(name=f"e{i}", namespace="default",
+                                        labels={"app": "existing"}),
+                spec=api.PodSpec(
+                    node_name=f"n{(i * 5) % n_nodes}",
+                    containers=[api.Container(
+                        name="c", image="pause",
+                        resources=api.ResourceRequirements(
+                            requests={"cpu": "100m", "memory": "128Mi"}))],
+                    **kw)))
+    args = make_plugin_args(nodes, service_lister=ListServiceLister([svc]))
+    return Tensorizer(plugin_args=args).build(nodes, existing, pending)

@@ -90,6 +90,24 @@ class TestShardedEquivalence:
         sharded = schedule_batch_sharded(ct, make_mesh(8))
         assert sharded == unsharded
 
+    def test_bench_shape_with_existing_pod_carries(self):
+        """Round-4 verdict #8: sharded == unsharded at bench-like shapes —
+        >=2k nodes with the FULL carry surface traced, including the
+        existing-pod sym/te tables (the driver's dryrun_multichip runs this
+        same config; here it's pinned in the suite)."""
+        from kubernetes_tpu.ops.fixtures import feature_batch
+        from kubernetes_tpu.ops.kernel import features_of
+
+        ct = feature_batch(n_nodes=2048, n_pods=384, with_existing=True)
+        feats = features_of(ct)
+        assert feats.sym and feats.te and feats.req and feats.anti \
+            and feats.pref and feats.disk and feats.ebs and feats.gce \
+            and feats.ports
+        unsharded = schedule_batch(ct)
+        sharded = schedule_batch_sharded(ct, make_mesh(8))
+        assert sharded == unsharded
+        assert all(g is not None for g in unsharded[: ct.n_real_pods])
+
     def test_mesh_shapes(self):
         """1x8 and 2x4 meshes agree with each other and the single device."""
         import numpy as np
