@@ -310,6 +310,13 @@ class SecurityContext:
 
 
 @dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: bool = False
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
@@ -318,6 +325,7 @@ class Container:
     ports: Optional[List[ContainerPort]] = None
     env: Optional[List[EnvVar]] = None
     resources: Optional[ResourceRequirements] = None
+    volume_mounts: Optional[List[VolumeMount]] = None
     image_pull_policy: str = ""  # Always | IfNotPresent | Never
     security_context: Optional[SecurityContext] = None
     liveness_probe: Optional["Probe"] = None
@@ -671,6 +679,7 @@ class PersistentVolumeSpec:
     access_modes: Optional[List[str]] = None
     gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
     aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    host_path: Optional[HostPathVolumeSource] = None
     claim_ref: Optional[ObjectReference] = None
     persistent_volume_reclaim_policy: str = ""
 

@@ -237,6 +237,16 @@ def validate_pod(pod: api.Pod) -> None:
                 _check(errs, key not in host_ports,
                        f"{pp}.hostPort: duplicate {key}")
                 host_ports.add(key)
+        mount_paths = set()
+        for j, m in enumerate(c.volume_mounts or []):
+            mp = f"{p}.volumeMounts[{j}]"
+            _check(errs, bool(m.name), f"{mp}.name: required")
+            _check(errs, not m.name or m.name in vol_names,
+                   f"{mp}.name: no volume named {m.name!r}")
+            _check(errs, bool(m.mount_path), f"{mp}.mountPath: required")
+            _check(errs, m.mount_path not in mount_paths,
+                   f"{mp}.mountPath: duplicate {m.mount_path!r}")
+            mount_paths.add(m.mount_path)
         _validate_probe(c.liveness_probe, errs, f"{p}.livenessProbe")
         _validate_probe(c.readiness_probe, errs, f"{p}.readinessProbe")
     if errs:

@@ -54,6 +54,11 @@ class Kubelet:
         # (reference --port + server.go:237)
         self.server_port: int = 0
         self.recorder = EventRecorder(client, "kubelet", source_host=node_name)
+        # PVC->PV resolution for the runtime's volume manager (the kubelet
+        # is the API-connected party; the runtime is not)
+        vm = getattr(self.runtime, "volumes", None)
+        if vm is not None and vm.resolver is None:
+            vm.resolver = client
         self._pod_ip_base = pod_ip_base
         self._ip_counter = 0
         self._statuses: Dict[str, tuple] = {}  # key -> last written signature
@@ -182,7 +187,18 @@ class Kubelet:
                                  message=err)
                 self.recorder.event(pod, "Warning", "FailedAdmission", err)
                 return
-            self.runtime.sync_pod(pod)
+            try:
+                self.runtime.sync_pod(pod)
+            except Exception as e:
+                # mount/spawn failure: surface it and stay Pending; the
+                # resync tick re-dispatches desired-but-not-running pods so
+                # a fixed hostPath / late-bound PVC heals without an event
+                # (reference: FailedMount events + WaitForAttachAndMount
+                # retry, volume_manager.go)
+                self.recorder.event(pod, "Warning", "FailedSync",
+                                    f"{type(e).__name__}: {e}")
+                log.warning("sync of %s failed: %s", key, e)
+                return
             self.recorder.event(pod, "Normal", "Started",
                                 f"Started pod {pod.metadata.name}")
             # pods with readiness probes start unready until the first
@@ -298,6 +314,18 @@ class Kubelet:
         # retry terminal status writes that failed transiently
         for key, args in list(self._pending_terminal.items()):
             self._set_status(*args)
+
+        # re-dispatch desired pods that never started (mount failures,
+        # transient spawn errors): the retry loop behind FailedSync above
+        running_now = self.runtime.running()
+        for key, pod in desired.items():
+            if key in running_now or key in self._terminal:
+                continue
+            phase = pod.status.phase if pod.status else ""
+            if phase in (api.POD_SUCCEEDED, api.POD_FAILED):
+                continue
+            if pod.spec and pod.spec.node_name == self.node_name:
+                self._sync_pod(pod)
 
         # PLEG: container deaths -> restart policy (pleg/generic.go:180)
         for ev in self.pleg.relist():

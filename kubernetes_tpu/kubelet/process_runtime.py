@@ -63,6 +63,12 @@ class ProcessRuntime(PodRuntime):
             "/tmp", f"kubernetes-tpu-pods-{os.getpid()}")
         os.makedirs(self.root, exist_ok=True)
         self.grace_seconds = grace_seconds
+        # kubelet-side volume pipeline (kubernetes_tpu/volume): emptyDir/
+        # hostPath/PVC/cloud sources materialize under the sandbox and are
+        # exposed to processes via $KTPU_MOUNTS (volume_manager.go analog);
+        # the kubelet injects the API resolver for PVC->PV lookups
+        from kubernetes_tpu.volume import VolumeManager
+        self.volumes = VolumeManager(self.root)
         self._lock = threading.Lock()
         self._pods: Dict[str, RunningPod] = {}
         self._procs: Dict[str, Dict[str, _Proc]] = {}  # key -> cname -> proc
@@ -86,6 +92,11 @@ class ProcessRuntime(PodRuntime):
         env["POD_NAME"] = pod.metadata.name
         env["POD_NAMESPACE"] = pod.metadata.namespace or "default"
         env["CONTAINER_NAME"] = c.name
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        # the container's volume view: $KTPU_MOUNTS/<mountPath with / -> _>
+        # is a symlink to the materialized volume (see kubernetes_tpu/volume)
+        env["KTPU_MOUNTS"] = os.path.join(self._pod_dir(key), "mounts",
+                                          c.name)
         for e in c.env or []:
             if e.name:
                 env[e.name] = e.value or ""
@@ -135,6 +146,9 @@ class ProcessRuntime(PodRuntime):
         with self._lock:
             if key in self._pods:
                 return
+            # volumes materialize BEFORE any container starts
+            # (volume_manager.go: WaitForAttachAndMount precedes SyncPod)
+            self.volumes.setup_pod(pod)
             procs: Dict[str, _Proc] = {}
             try:
                 for c in pod.spec.containers or []:
@@ -142,9 +156,11 @@ class ProcessRuntime(PodRuntime):
             except OSError:
                 # a later container's argv failed to spawn: reap the
                 # already-started siblings — nothing may outlive an
-                # unregistered pod (kill_pod couldn't find it)
+                # unregistered pod (kill_pod couldn't find it) — and put
+                # the materialized volumes back too
                 for proc in procs.values():
                     self._terminate(proc, 0.5)
+                self.volumes.teardown_pod(key)
                 raise
             self._procs[key] = procs
             self._pods[key] = RunningPod(
@@ -158,6 +174,7 @@ class ProcessRuntime(PodRuntime):
             self._pods.pop(pod_key, None)
         for proc in procs.values():
             self._terminate(proc, self.grace_seconds)
+        self.volumes.teardown_pod(pod_key)
 
     def running(self) -> Dict[str, RunningPod]:
         with self._lock:

@@ -1,0 +1,203 @@
+"""L7 volume pipeline: plugins + the kubelet-side volume manager.
+
+Parity target: reference pkg/volume/ (plugin drivers) +
+pkg/kubelet/volume_manager.go — the other half of the PV story: the
+binder controller matches claims to volumes, and THIS code materializes
+them on the node. There is no mount(2) privilege or cloud API in this
+environment, so the tpu-native analog materializes volumes as real
+directories under the pod sandbox:
+
+  - emptyDir      -> a fresh directory, deleted with the pod (the
+                     reference's tmpfs/disk emptyDir lifecycle)
+  - hostPath      -> the host path itself (validated to exist)
+  - PVC           -> resolved claim -> bound PV -> that PV's source:
+                     hostPath PVs materialize at their path; EBS/GCE PVs
+                     "attach" as a per-volume directory under the
+                     manager's attach root with a marker file recording
+                     the volume id (the attach/detach bookkeeping the
+                     MaxPDVolumeCount predicates meter)
+  - EBS/GCE inline sources attach the same way
+
+Exposure convention (documented in ProcessRuntime): each container gets a
+mount-root directory `{pod_dir}/mounts/{container}` whose entries mirror
+its volumeMounts — entry name = the mountPath with '/' mapped to '_'
+(e.g. /data -> data; colliding names are rejected at setup) — each a
+symlink to the materialized volume. The process finds it via
+$KTPU_MOUNTS. readOnly is recorded in the API and validated, but NOT
+enforced at the filesystem layer: without mount namespaces a same-inode
+read-only view does not exist, and chmod'ing the shared source would
+block legitimate writers. This is a documented divergence from the
+reference's mount(2)-level ro.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import types as api
+
+
+class VolumeError(Exception):
+    pass
+
+
+def _mount_entry_name(mount_path: str) -> str:
+    return mount_path.strip("/").replace("/", "_") or "root"
+
+
+class VolumeManager:
+    """Per-kubelet volume lifecycle: setup_pod before the runtime starts
+    containers, teardown_pod after it kills them."""
+
+    def __init__(self, root: str, pv_resolver=None):
+        """pv_resolver: object with get(resource, name, ns) — normally the
+        kubelet's RESTClient; None disables PVC resolution."""
+        self.root = root
+        self.attach_root = os.path.join(root, "attached")
+        os.makedirs(self.attach_root, exist_ok=True)
+        self.resolver = pv_resolver
+        self._lock = threading.Lock()
+        # pod key -> volume name -> materialized path
+        self._mounted: Dict[str, Dict[str, str]] = {}
+        # pod key -> paths owned by the manager (deleted on teardown)
+        self._owned: Dict[str, List[str]] = {}
+
+    # -- plugin dispatch -------------------------------------------------------
+
+    def _materialize(self, key: str, pod: api.Pod,
+                     vol: api.Volume) -> Tuple[str, bool]:
+        """(path, manager_owned) for one volume source."""
+        if vol.empty_dir is not None:
+            path = os.path.join(self.root, key.replace("/", "_"),
+                                "volumes", vol.name)
+            os.makedirs(path, exist_ok=True)
+            return path, True
+        if vol.host_path is not None:
+            path = vol.host_path.path
+            if not os.path.exists(path):
+                raise VolumeError(f"hostPath {path!r} does not exist")
+            return path, False
+        if vol.aws_elastic_block_store is not None:
+            return self._attach("ebs", vol.aws_elastic_block_store.volume_id), True
+        if vol.gce_persistent_disk is not None:
+            return self._attach("gce", vol.gce_persistent_disk.pd_name), True
+        if vol.persistent_volume_claim is not None:
+            return self._materialize_pvc(pod, vol)
+        raise VolumeError(f"volume {vol.name!r}: no supported source")
+
+    def _attach(self, family: str, volume_id: str) -> str:
+        """Fake cloud attach: a stable per-volume directory + marker file
+        (the bookkeeping half of the reference's attach/detach controller —
+        the data itself is local, there being no cloud)."""
+        path = os.path.join(self.attach_root, f"{family}-{volume_id}")
+        os.makedirs(path, exist_ok=True)
+        marker = os.path.join(path, ".attached")
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write(f"{family}:{volume_id}\n")
+        return path
+
+    def _materialize_pvc(self, pod: api.Pod,
+                         vol: api.Volume) -> Tuple[str, bool]:
+        if self.resolver is None:
+            raise VolumeError("PVC volumes need an API resolver")
+        ns = pod.metadata.namespace or "default"
+        claim = self.resolver.get("persistentvolumeclaims",
+                                  vol.persistent_volume_claim.claim_name, ns)
+        pv_name = claim.spec.volume_name if claim.spec else ""
+        if not pv_name:
+            raise VolumeError(
+                f"claim {vol.persistent_volume_claim.claim_name!r} is unbound")
+        pv = self.resolver.get("persistentvolumes", pv_name)
+        src = pv.spec
+        if src is None:
+            raise VolumeError(f"PV {pv_name!r} has no source")
+        if src.host_path is not None:
+            if not os.path.exists(src.host_path.path):
+                os.makedirs(src.host_path.path, exist_ok=True)
+            return src.host_path.path, False
+        if src.aws_elastic_block_store is not None:
+            return self._attach(
+                "ebs", src.aws_elastic_block_store.volume_id), True
+        if src.gce_persistent_disk is not None:
+            return self._attach("gce", src.gce_persistent_disk.pd_name), True
+        raise VolumeError(f"PV {pv_name!r}: no supported source")
+
+    # -- pod lifecycle ---------------------------------------------------------
+
+    def setup_pod(self, pod: api.Pod) -> Dict[str, Dict[str, str]]:
+        """Materialize every volume and build the per-container mount view.
+        Returns {container name: {mount entry: path}} for the runtime."""
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        spec = pod.spec
+        if spec is None:
+            return {}
+        with self._lock:
+            vols: Dict[str, str] = {}
+            owned: List[str] = []
+            try:
+                for vol in spec.volumes or []:
+                    path, is_owned = self._materialize(key, pod, vol)
+                    vols[vol.name] = path
+                    if is_owned:
+                        owned.append(path)
+                views: Dict[str, Dict[str, str]] = {}
+                pod_dir = os.path.join(self.root, key.replace("/", "_"))
+                for c in spec.containers or []:
+                    view_dir = os.path.join(pod_dir, "mounts", c.name)
+                    os.makedirs(view_dir, exist_ok=True)
+                    entries: Dict[str, str] = {}
+                    seen_links: Dict[str, str] = {}
+                    for m in c.volume_mounts or []:
+                        src = vols.get(m.name)
+                        if src is None:
+                            raise VolumeError(
+                                f"container {c.name!r} mounts unknown "
+                                f"volume {m.name!r}")
+                        entry = _mount_entry_name(m.mount_path)
+                        if entry in seen_links:
+                            raise VolumeError(
+                                f"container {c.name!r}: mount paths "
+                                f"{seen_links[entry]!r} and "
+                                f"{m.mount_path!r} collide in the view "
+                                f"(both map to {entry!r})")
+                        seen_links[entry] = m.mount_path
+                        link = os.path.join(view_dir, entry)
+                        if os.path.islink(link):
+                            os.unlink(link)
+                        os.symlink(src, link)
+                        entries[m.mount_path] = src
+                    views[c.name] = entries
+            except VolumeError:
+                # rollback: manager-created paths from earlier volumes of
+                # this failed setup must not leak
+                for path in owned:
+                    if not path.startswith(self.attach_root):
+                        shutil.rmtree(path, ignore_errors=True)
+                pod_dir = os.path.join(self.root, key.replace("/", "_"))
+                shutil.rmtree(os.path.join(pod_dir, "mounts"),
+                              ignore_errors=True)
+                raise
+            self._mounted[key] = vols
+            self._owned[key] = owned
+            return views
+
+    def teardown_pod(self, key: str) -> None:
+        """emptyDir contents die with the pod; attached/hostPath survive
+        (the reference reclaims PVs via the recycler, not the kubelet)."""
+        with self._lock:
+            self._mounted.pop(key, None)
+            owned = self._owned.pop(key, [])
+        pod_dir = os.path.join(self.root, key.replace("/", "_"))
+        for path in owned:
+            if path.startswith(os.path.join(self.root, "attached")):
+                continue  # attach bookkeeping outlives the pod
+            shutil.rmtree(path, ignore_errors=True)
+        shutil.rmtree(os.path.join(pod_dir, "mounts"), ignore_errors=True)
+
+    def mounted(self, key: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._mounted.get(key, {}))

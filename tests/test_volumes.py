@@ -1,0 +1,291 @@
+"""Kubelet volume pipeline: real directories behind pod volumes.
+
+Parity target: reference pkg/volume/ + pkg/kubelet/volume_manager.go —
+the node-side half of the PV story (round-4 verdict missing #3). The
+ProcessRuntime makes it physical: emptyDir shares real files between
+containers of a pod, hostPath exposes host files, PVC resolves through
+the bound PV, cloud sources leave attach bookkeeping.
+"""
+
+import os
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
+from kubernetes_tpu.kubelet.runtime import FakeCadvisor
+from kubernetes_tpu.volume import VolumeError, VolumeManager
+
+
+def wait_for(cond, timeout=20.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def vol_pod(name, volumes, containers, ns="default"):
+    return api.Pod(metadata=api.ObjectMeta(name=name, namespace=ns),
+                   spec=api.PodSpec(volumes=volumes, containers=containers,
+                                    restart_policy="Never"))
+
+
+class TestVolumeManagerUnit:
+    def test_empty_dir_lifecycle(self, tmp_path):
+        vm = VolumeManager(str(tmp_path))
+        pod = vol_pod(
+            "e", [api.Volume(name="scratch",
+                             empty_dir=api.EmptyDirVolumeSource())],
+            [api.Container(name="c", image="i", volume_mounts=[
+                api.VolumeMount(name="scratch", mount_path="/data")])])
+        views = vm.setup_pod(pod)
+        path = views["c"]["/data"]
+        assert os.path.isdir(path)
+        open(os.path.join(path, "f"), "w").write("x")
+        vm.teardown_pod("default/e")
+        assert not os.path.exists(path)  # emptyDir dies with the pod
+
+    def test_host_path_passthrough_and_survival(self, tmp_path):
+        host = tmp_path / "host"
+        host.mkdir()
+        (host / "seed").write_text("host data")
+        vm = VolumeManager(str(tmp_path / "root"))
+        pod = vol_pod(
+            "h", [api.Volume(name="hp", host_path=api.HostPathVolumeSource(
+                path=str(host)))],
+            [api.Container(name="c", image="i", volume_mounts=[
+                api.VolumeMount(name="hp", mount_path="/host")])])
+        views = vm.setup_pod(pod)
+        assert views["c"]["/host"] == str(host)
+        vm.teardown_pod("default/h")
+        assert (host / "seed").read_text() == "host data"  # survives
+
+    def test_missing_host_path_rejected(self, tmp_path):
+        vm = VolumeManager(str(tmp_path))
+        pod = vol_pod(
+            "m", [api.Volume(name="hp", host_path=api.HostPathVolumeSource(
+                path=str(tmp_path / "nope")))],
+            [api.Container(name="c", image="i")])
+        with pytest.raises(VolumeError):
+            vm.setup_pod(pod)
+
+    def test_unknown_mount_rejected(self, tmp_path):
+        vm = VolumeManager(str(tmp_path))
+        pod = vol_pod(
+            "u", None,
+            [api.Container(name="c", image="i", volume_mounts=[
+                api.VolumeMount(name="ghost", mount_path="/x")])])
+        with pytest.raises(VolumeError):
+            vm.setup_pod(pod)
+
+    def test_cloud_attach_bookkeeping_survives_pod(self, tmp_path):
+        vm = VolumeManager(str(tmp_path))
+        pod = vol_pod(
+            "a", [api.Volume(name="data",
+                             aws_elastic_block_store=
+                             api.AWSElasticBlockStoreVolumeSource(
+                                 volume_id="vol-9"))],
+            [api.Container(name="c", image="i", volume_mounts=[
+                api.VolumeMount(name="data", mount_path="/data")])])
+        views = vm.setup_pod(pod)
+        marker = os.path.join(views["c"]["/data"], ".attached")
+        assert open(marker).read().strip() == "ebs:vol-9"
+        vm.teardown_pod("default/a")
+        assert os.path.exists(marker)  # attach record outlives the pod
+
+
+class TestVolumesThroughProcessRuntime:
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        server = APIServer().start()
+        client = RESTClient.for_server(server)
+        rt = ProcessRuntime(root_dir=str(tmp_path / "pods"))
+        kl = Kubelet(client, "vnode", runtime=rt, cadvisor=FakeCadvisor(),
+                     heartbeat_period=5.0, sync_period=0.2)
+        kl.start()
+        try:
+            yield server, client, rt
+        finally:
+            kl.stop()
+            rt.cleanup()
+            server.stop()
+
+    def _schedule(self, client, pod):
+        client.create("pods", pod)
+        client.bind(api.Binding(
+            metadata=api.ObjectMeta(name=pod.metadata.name),
+            target=api.ObjectReference(kind="Node", name="vnode")),
+            pod.metadata.namespace or "default")
+
+    def test_empty_dir_shared_between_containers(self, stack):
+        """The volume IS shared state: the writer's file appears in the
+        reader's view — two real processes, one real directory."""
+        server, client, rt = stack
+        pod = vol_pod(
+            "share",
+            [api.Volume(name="shared",
+                        empty_dir=api.EmptyDirVolumeSource())],
+            [api.Container(
+                name="writer", image="i",
+                command=["/bin/sh", "-c",
+                         'echo payload > "$KTPU_MOUNTS/data/msg"; sleep 600'],
+                volume_mounts=[api.VolumeMount(name="shared",
+                                               mount_path="/data")]),
+             api.Container(
+                 name="reader", image="i",
+                 command=["/bin/sh", "-c", "sleep 600"],
+                 volume_mounts=[api.VolumeMount(name="shared",
+                                                mount_path="/data")])])
+        pod.spec.restart_policy = "Always"
+        self._schedule(client, pod)
+        wait_for(lambda: "default/share" in rt.running(), msg="pod running")
+
+        def read_back():
+            rc, out = rt.exec("default/share", "reader",
+                              ["/bin/sh", "-c", 'cat "$KTPU_MOUNTS/data/msg"'])
+            return out.strip() if rc == 0 else None
+        assert wait_for(read_back, msg="shared payload") == "payload"
+
+    def test_pvc_resolves_through_bound_pv(self, stack, tmp_path):
+        """claim -> bound PV (hostPath) -> the pod writes into the PV's
+        real path — the full PV story end to end."""
+        server, client, rt = stack
+        pv_dir = tmp_path / "pv-store"
+        pv_dir.mkdir()
+        client.create("persistentvolumes", api.PersistentVolume(
+            metadata=api.ObjectMeta(name="pv1"),
+            spec=api.PersistentVolumeSpec(
+                capacity={"storage": "1Gi"},
+                host_path=api.HostPathVolumeSource(path=str(pv_dir)))))
+        client.create("persistentvolumeclaims", api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="claim1", namespace="default"),
+            spec=api.PersistentVolumeClaimSpec(volume_name="pv1")))
+        pod = vol_pod(
+            "pvc-user",
+            [api.Volume(name="store",
+                        persistent_volume_claim=
+                        api.PersistentVolumeClaimVolumeSource(
+                            claim_name="claim1"))],
+            [api.Container(
+                name="c", image="i",
+                command=["/bin/sh", "-c",
+                         'echo durable > "$KTPU_MOUNTS/store/out"; sleep 600'],
+                volume_mounts=[api.VolumeMount(name="store",
+                                               mount_path="/store")])])
+        pod.spec.restart_policy = "Always"
+        self._schedule(client, pod)
+        wait_for(lambda: (pv_dir / "out").exists(), msg="write into PV")
+        assert (pv_dir / "out").read_text().strip() == "durable"
+        # pod teardown leaves the PV's data (reclaim is the controller's job)
+        rt.kill_pod("default/pvc-user")
+        assert (pv_dir / "out").exists()
+
+    def test_unbound_pvc_keeps_pod_pending(self, stack):
+        server, client, rt = stack
+        client.create("persistentvolumeclaims", api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="floating", namespace="default"),
+            spec=api.PersistentVolumeClaimSpec()))
+        pod = vol_pod(
+            "stuck",
+            [api.Volume(name="v",
+                        persistent_volume_claim=
+                        api.PersistentVolumeClaimVolumeSource(
+                            claim_name="floating"))],
+            [api.Container(name="c", image="i")])
+        self._schedule(client, pod)
+        time.sleep(1.0)
+        assert "default/stuck" not in rt.running()
+
+
+class TestVolumeValidation:
+    def test_unknown_mount_rejected_at_admission(self):
+        from kubernetes_tpu.api.validation import ValidationError, validate_pod
+        pod = vol_pod(
+            "v", [api.Volume(name="data",
+                             empty_dir=api.EmptyDirVolumeSource())],
+            [api.Container(name="c", image="i", volume_mounts=[
+                api.VolumeMount(name="dtaa", mount_path="/data")])])
+        with pytest.raises(ValidationError) as ei:
+            validate_pod(pod)
+        assert "no volume named" in str(ei.value)
+
+    def test_duplicate_mount_path_rejected(self):
+        from kubernetes_tpu.api.validation import ValidationError, validate_pod
+        pod = vol_pod(
+            "v", [api.Volume(name="a", empty_dir=api.EmptyDirVolumeSource()),
+                  api.Volume(name="b", empty_dir=api.EmptyDirVolumeSource())],
+            [api.Container(name="c", image="i", volume_mounts=[
+                api.VolumeMount(name="a", mount_path="/data"),
+                api.VolumeMount(name="b", mount_path="/data")])])
+        with pytest.raises(ValidationError):
+            validate_pod(pod)
+
+    def test_colliding_view_entries_rejected_at_setup(self, tmp_path):
+        vm = VolumeManager(str(tmp_path))
+        pod = vol_pod(
+            "v", [api.Volume(name="a", empty_dir=api.EmptyDirVolumeSource()),
+                  api.Volume(name="b", empty_dir=api.EmptyDirVolumeSource())],
+            [api.Container(name="c", image="i", volume_mounts=[
+                api.VolumeMount(name="a", mount_path="/data/logs"),
+                api.VolumeMount(name="b", mount_path="/data_logs")])])
+        with pytest.raises(VolumeError) as ei:
+            vm.setup_pod(pod)
+        assert "collide" in str(ei.value)
+
+    def test_partial_setup_rolls_back_owned_paths(self, tmp_path):
+        vm = VolumeManager(str(tmp_path / "root"))
+        pod = vol_pod(
+            "v", [api.Volume(name="good",
+                             empty_dir=api.EmptyDirVolumeSource()),
+                  api.Volume(name="bad", host_path=api.HostPathVolumeSource(
+                      path=str(tmp_path / "missing")))],
+            [api.Container(name="c", image="i")])
+        with pytest.raises(VolumeError):
+            vm.setup_pod(pod)
+        assert not os.path.exists(os.path.join(
+            str(tmp_path / "root"), "default_v", "volumes", "good"))
+        assert vm.mounted("default/v") == {}
+
+
+class TestFailedMountHeals:
+    def test_late_host_path_heals_via_resync(self, tmp_path):
+        """Missing hostPath -> FailedSync, pod Pending; the path appearing
+        later heals it on the resync tick without any new watch event."""
+        server = APIServer().start()
+        client = RESTClient.for_server(server)
+        rt = ProcessRuntime(root_dir=str(tmp_path / "pods"))
+        kl = Kubelet(client, "vnode", runtime=rt, cadvisor=FakeCadvisor(),
+                     heartbeat_period=5.0, sync_period=0.2)
+        kl.start()
+        try:
+            host = tmp_path / "appears-later"
+            pod = vol_pod(
+                "heal", [api.Volume(name="hp",
+                                    host_path=api.HostPathVolumeSource(
+                                        path=str(host)))],
+                [api.Container(name="c", image="i",
+                               command=["/bin/sh", "-c", "sleep 600"],
+                               volume_mounts=[api.VolumeMount(
+                                   name="hp", mount_path="/host")])])
+            pod.spec.restart_policy = "Always"
+            client.create("pods", pod)
+            client.bind(api.Binding(
+                metadata=api.ObjectMeta(name="heal"),
+                target=api.ObjectReference(kind="Node", name="vnode")),
+                "default")
+            time.sleep(1.0)
+            assert "default/heal" not in rt.running()
+            host.mkdir()
+            wait_for(lambda: "default/heal" in rt.running(),
+                     msg="pod healed after hostPath appeared")
+        finally:
+            kl.stop()
+            rt.cleanup()
+            server.stop()
