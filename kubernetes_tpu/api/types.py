@@ -552,8 +552,19 @@ class ServiceSpec:
 
 
 @dataclass
+class LoadBalancerIngress:
+    ip: str = api_field("ip", default="")
+    hostname: str = ""
+
+
+@dataclass
+class LoadBalancerStatus:
+    ingress: Optional[List[LoadBalancerIngress]] = None
+
+
+@dataclass
 class ServiceStatus:
-    pass
+    load_balancer: Optional[LoadBalancerStatus] = None
 
 
 @dataclass

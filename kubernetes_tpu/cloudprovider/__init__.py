@@ -1,0 +1,97 @@
+"""Cloud provider seam.
+
+Parity target: reference pkg/cloudprovider/providers.go —
+cloudprovider.Interface with LoadBalancer() and Routes() facets consumed
+by the service and route controllers. There are no cloud APIs in this
+environment, so the shipped implementation is the in-memory FakeCloud
+(the analog of pkg/cloudprovider/providers/fake), which records the calls
+and allocates load-balancer IPs deterministically; real providers slot in
+behind the same three-method facets.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class CloudProvider:
+    """What the controllers need from a cloud (the two facets used by
+    servicecontroller.go / routecontroller.go)."""
+
+    # -- LoadBalancer facet ----------------------------------------------------
+
+    def ensure_load_balancer(self, name: str, ports: List[int],
+                             node_names: List[str]) -> str:
+        """Create/update the LB; returns its ingress IP."""
+        raise NotImplementedError
+
+    def delete_load_balancer(self, name: str) -> None:
+        raise NotImplementedError
+
+    def get_load_balancer(self, name: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    # -- Routes facet ----------------------------------------------------------
+
+    def create_route(self, node_name: str, cidr: str) -> None:
+        raise NotImplementedError
+
+    def delete_route(self, node_name: str) -> None:
+        raise NotImplementedError
+
+    def list_routes(self) -> Dict[str, str]:
+        """node name -> cidr."""
+        raise NotImplementedError
+
+
+class FakeCloud(CloudProvider):
+    """Deterministic in-memory cloud: LB IPs from 203.0.113.0/24 (TEST-NET),
+    routes in a dict. Thread-safe; every mutating call is recorded in
+    `calls` for assertions."""
+
+    def __init__(self, lb_cidr: str = "203.0.113.0/24"):
+        self._lock = threading.Lock()
+        self._net = ipaddress.ip_network(lb_cidr)
+        self._lbs: Dict[str, dict] = {}
+        self._routes: Dict[str, str] = {}
+        self._next_ip = 0
+        self.calls: List[Tuple] = []
+
+    def ensure_load_balancer(self, name, ports, node_names):
+        with self._lock:
+            self.calls.append(("ensure_lb", name, tuple(ports),
+                               tuple(sorted(node_names))))
+            lb = self._lbs.get(name)
+            if lb is None:
+                self._next_ip += 1
+                lb = {"ip": str(self._net[self._next_ip])}
+                self._lbs[name] = lb
+            lb["ports"] = list(ports)
+            lb["nodes"] = sorted(node_names)
+            return lb["ip"]
+
+    def delete_load_balancer(self, name):
+        with self._lock:
+            self.calls.append(("delete_lb", name))
+            self._lbs.pop(name, None)
+
+    def get_load_balancer(self, name):
+        with self._lock:
+            lb = self._lbs.get(name)
+            return dict(lb) if lb else None
+
+    def create_route(self, node_name, cidr):
+        with self._lock:
+            self.calls.append(("create_route", node_name, cidr))
+            self._routes[node_name] = cidr
+
+    def delete_route(self, node_name):
+        with self._lock:
+            self.calls.append(("delete_route", node_name))
+            self._routes.pop(node_name, None)
+
+    def list_routes(self):
+        with self._lock:
+            return dict(self._routes)

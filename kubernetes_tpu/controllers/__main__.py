@@ -22,12 +22,22 @@ def main(argv=None) -> int:
     p.add_argument("--master", default="http://127.0.0.1:8080")
     p.add_argument("--port", type=int, default=10252)
     p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--cloud-provider", default="",
+                   choices=("", "fake"),
+                   help="enables the service-LB + route controllers")
+    p.add_argument("--allocate-node-cidrs", action="store_true")
     a = p.parse_args(argv)
     cfg = ControllerManagerConfiguration(port=a.port,
                                          leader_elect=a.leader_elect)
 
     client = client_from_url(a.master, qps=1000, burst=1000)
-    mgr = ControllerManager(client, leader_elect=cfg.leader_elect)
+    cloud = None
+    if a.cloud_provider == "fake":
+        from kubernetes_tpu.cloudprovider import FakeCloud
+        cloud = FakeCloud()
+    mgr = ControllerManager(client, leader_elect=cfg.leader_elect,
+                            cloud=cloud,
+                            allocate_node_cidrs=a.allocate_node_cidrs)
     mgr.start()
     debug = DebugServer(port=cfg.port,
                         configz={"componentconfig": cfg}).start()

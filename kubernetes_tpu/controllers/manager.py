@@ -42,10 +42,15 @@ log = logging.getLogger("controller-manager")
 
 class ControllerManager:
     def __init__(self, client: RESTClient, leader_elect: bool = False,
-                 identity: str = "controller-manager"):
+                 identity: str = "controller-manager", cloud=None,
+                 allocate_node_cidrs: bool = False):
         self.client = client
         self.leader_elect = leader_elect
         self.identity = identity
+        # cloud provider seam (servicecontroller + routecontroller start
+        # only when a cloud is configured, controllermanager.go:362-399)
+        self.cloud = cloud
+        self.allocate_node_cidrs = allocate_node_cidrs
         self.controllers: List = []
         self._elector: Optional[LeaderElector] = None
         self._started = False
@@ -73,6 +78,17 @@ class ControllerManager:
             PetSetController(self.client),
             ScheduledJobController(self.client),
         ]
+        if self.cloud is not None:
+            from kubernetes_tpu.controllers.route_controller import (
+                RouteController,
+            )
+            from kubernetes_tpu.controllers.service_controller import (
+                ServiceController,
+            )
+            self.controllers.append(ServiceController(self.client, self.cloud))
+            if self.allocate_node_cidrs:
+                self.controllers.append(
+                    RouteController(self.client, self.cloud))
         for c in self.controllers:
             c.start()
         log.info("controller-manager: %d controllers running",

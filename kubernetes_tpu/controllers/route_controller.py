@@ -1,0 +1,92 @@
+"""Route controller + node pod-CIDR allocation.
+
+Parity target: reference pkg/controller/route/routecontroller.go (one
+cloud route per node's podCIDR, orphaned routes removed) plus the
+controller-manager's --allocate-node-cidrs path: nodes without a
+spec.podCIDR get one carved out of the cluster CIDR here, since there is
+no separate nodeipam controller in this tree.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import logging
+import threading
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+
+log = logging.getLogger("route-controller")
+
+
+class RouteController(Controller):
+    name = "routes"
+
+    def __init__(self, client: RESTClient, cloud,
+                 cluster_cidr: str = "10.244.0.0/16", node_mask: int = 24,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.cloud = cloud
+        self.net = ipaddress.ip_network(cluster_cidr)
+        self.node_mask = node_mask
+        self._cidr_lock = threading.Lock()
+        # CIDRs handed out but possibly not yet visible in the informer
+        # store: without this, two back-to-back node syncs both read the
+        # stale store and collide on the same subnet
+        self._issued: set = set()
+        self.node_informer = Informer(ListWatch(client, "nodes"))
+        self.node_informer.add_event_handler(
+            on_add=lambda n: self.enqueue(n.metadata.name),
+            on_update=lambda o, n: self.enqueue(n.metadata.name),
+            on_delete=lambda n: self.enqueue(n.metadata.name))
+
+    # -- pod CIDR allocation ---------------------------------------------------
+
+    def _used_cidrs(self):
+        return {n.spec.pod_cidr for n in self.node_informer.store.list()
+                if n.spec and n.spec.pod_cidr}
+
+    def _allocate_cidr(self) -> str:
+        with self._cidr_lock:
+            used = self._used_cidrs() | self._issued
+            for subnet in self.net.subnets(new_prefix=self.node_mask):
+                s = str(subnet)
+                if s not in used:
+                    self._issued.add(s)
+                    return s
+        raise RuntimeError(f"cluster CIDR {self.net} exhausted")
+
+    def sync(self, key: str) -> None:
+        node = self.node_informer.store.get(key)
+        if node is None:
+            # node gone: its route must go too (routecontroller.go reconcile)
+            if key in self.cloud.list_routes():
+                self.cloud.delete_route(key)
+                log.info("deleted route for departed node %s", key)
+            return
+        cidr = node.spec.pod_cidr if node.spec else ""
+        if not cidr:
+            cidr = self._allocate_cidr()
+            try:
+                self.client.patch("nodes", key,
+                                  {"spec": {"podCIDR": cidr}})
+            except ApiError as e:
+                if e.is_not_found:
+                    return
+                raise
+            log.info("allocated podCIDR %s to node %s", cidr, key)
+        if self.cloud.list_routes().get(key) != cidr:
+            self.cloud.create_route(key, cidr)
+            log.info("route %s -> %s", key, cidr)
+
+    def start(self):
+        self.node_informer.run()
+        self.node_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.node_informer.stop()

@@ -1,0 +1,119 @@
+"""Service load-balancer controller.
+
+Parity target: reference pkg/controller/service/servicecontroller.go —
+for every Service of type LoadBalancer, ensure a cloud LB fronting the
+ready nodes and publish its ingress IP in status.loadBalancer; tear the
+LB down when the service is deleted or its type changes away. Node
+readiness changes re-target every LB (the reference's nodeSyncLoop).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.controllers.base import Controller
+
+log = logging.getLogger("service-controller")
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def _lb_name(key: str) -> str:
+    return "lb-" + key.replace("/", "-")
+
+
+def _node_ready(node: api.Node) -> bool:
+    for c in (node.status.conditions or []) if node.status else []:
+        if c.type == api.NODE_READY:
+            return c.status == api.CONDITION_TRUE
+    return False
+
+
+class ServiceController(Controller):
+    name = "service-lb"
+
+    def __init__(self, client: RESTClient, cloud, workers: int = 2):
+        super().__init__(workers)
+        self.client = client
+        self.cloud = cloud
+        self.svc_informer = Informer(ListWatch(client, "services"))
+        self.node_informer = Informer(ListWatch(client, "nodes"))
+        self.svc_informer.add_event_handler(
+            on_add=lambda s: self.enqueue(_key(s)),
+            on_update=lambda o, n: self.enqueue(_key(n)),
+            on_delete=lambda s: self.enqueue(_key(s)))
+        # node membership changes re-target every LB (nodeSyncLoop)
+        self.node_informer.add_event_handler(
+            on_add=lambda n: self._resync_all(),
+            on_update=self._node_updated,
+            on_delete=lambda n: self._resync_all())
+
+    def _node_updated(self, old: api.Node, new: api.Node):
+        if _node_ready(old) != _node_ready(new):
+            self._resync_all()
+
+    def _resync_all(self):
+        for svc in self.svc_informer.store.list():
+            if svc.spec and svc.spec.type == "LoadBalancer":
+                self.enqueue(_key(svc))
+
+    def _ready_node_names(self):
+        return sorted(n.metadata.name for n in self.node_informer.store.list()
+                      if _node_ready(n))
+
+    def sync(self, key: str) -> None:
+        svc = self.svc_informer.store.get(key)
+        if svc is None or svc.spec is None \
+                or svc.spec.type != "LoadBalancer":
+            # deleted or no longer LB-typed: the cloud resource must go
+            if self.cloud.get_load_balancer(_lb_name(key)) is not None:
+                self.cloud.delete_load_balancer(_lb_name(key))
+                log.info("deleted load balancer for %s", key)
+            if svc is not None and svc.status \
+                    and svc.status.load_balancer \
+                    and svc.status.load_balancer.ingress:
+                self._patch_status(svc, None)
+            return
+        ports = [p.port for p in (svc.spec.ports or [])]
+        ip = self.cloud.ensure_load_balancer(
+            _lb_name(key), ports, self._ready_node_names())
+        cur = ""
+        if svc.status and svc.status.load_balancer \
+                and svc.status.load_balancer.ingress:
+            cur = svc.status.load_balancer.ingress[0].ip
+        if cur != ip:
+            self._patch_status(
+                svc, api.LoadBalancerStatus(
+                    ingress=[api.LoadBalancerIngress(ip=ip)]))
+            log.info("service %s load balancer at %s", key, ip)
+
+    def _patch_status(self, svc: api.Service, lb) -> None:
+        from kubernetes_tpu.api.serialization import scheme
+        enc = (scheme.encode(api.Service(status=api.ServiceStatus(
+            load_balancer=lb))).get("status") or {})
+        try:
+            self.client.patch(
+                "services", svc.metadata.name,
+                {"status": {"loadBalancer": enc.get("loadBalancer")}},
+                svc.metadata.namespace or "default",
+                patch_type=self.client.MERGE_PATCH)
+        except ApiError as e:
+            if not e.is_not_found:
+                raise
+
+    def start(self):
+        self.svc_informer.run()
+        self.node_informer.run()
+        self.svc_informer.wait_for_sync()
+        self.node_informer.wait_for_sync()
+        return self.run()
+
+    def stop(self):
+        super().stop()
+        self.svc_informer.stop()
+        self.node_informer.stop()

@@ -72,6 +72,10 @@ class Kubelet:
         # (a stuck phase=Running in the API strands node capacity forever)
         self._pending_terminal: Dict[str, tuple] = {}
         self._heartbeat_lock = threading.Lock()
+        # serializes pod deletion (informer thread) against the resync
+        # tick's re-dispatch (resync thread): without it a stale desired
+        # snapshot can restart a pod whose DELETE landed mid-loop
+        self._lifecycle_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads = []
         self.probes = ProbeManager(self.runtime)
@@ -293,11 +297,12 @@ class Kubelet:
 
     def _pod_deleted(self, pod: api.Pod):
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
-        self.runtime.kill_pod(key)
-        self.probes.forget_pod(key)
-        self._statuses.pop(key, None)
-        self._ready.pop(key, None)
-        self._terminal.discard(key)  # a recreated name starts fresh
+        with self._lifecycle_lock:
+            self.runtime.kill_pod(key)
+            self.probes.forget_pod(key)
+            self._statuses.pop(key, None)
+            self._ready.pop(key, None)
+            self._terminal.discard(key)  # a recreated name starts fresh
 
     def _resync(self):
         """Desired-state reconcile (kill runtime pods no longer desired)
@@ -316,16 +321,23 @@ class Kubelet:
             self._set_status(*args)
 
         # re-dispatch desired pods that never started (mount failures,
-        # transient spawn errors): the retry loop behind FailedSync above
+        # transient spawn errors): the retry loop behind FailedSync above.
+        # Per-pod under the lifecycle lock, against the CURRENT store
+        # object — a DELETE landing mid-loop must not be resurrected from
+        # the stale `desired` snapshot
         running_now = self.runtime.running()
-        for key, pod in desired.items():
+        for key in list(desired):
             if key in running_now or key in self._terminal:
                 continue
-            phase = pod.status.phase if pod.status else ""
-            if phase in (api.POD_SUCCEEDED, api.POD_FAILED):
-                continue
-            if pod.spec and pod.spec.node_name == self.node_name:
-                self._sync_pod(pod)
+            with self._lifecycle_lock:
+                pod = self.pod_informer.store.get(key)
+                if pod is None or pod.metadata.deletion_timestamp is not None:
+                    continue
+                phase = pod.status.phase if pod.status else ""
+                if phase in (api.POD_SUCCEEDED, api.POD_FAILED):
+                    continue
+                if pod.spec and pod.spec.node_name == self.node_name:
+                    self._sync_pod(pod)
 
         # PLEG: container deaths -> restart policy (pleg/generic.go:180)
         for ev in self.pleg.relist():

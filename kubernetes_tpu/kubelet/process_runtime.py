@@ -146,9 +146,14 @@ class ProcessRuntime(PodRuntime):
         with self._lock:
             if key in self._pods:
                 return
-            # volumes materialize BEFORE any container starts
-            # (volume_manager.go: WaitForAttachAndMount precedes SyncPod)
-            self.volumes.setup_pod(pod)
+        # volumes materialize BEFORE any container starts
+        # (volume_manager.go: WaitForAttachAndMount precedes SyncPod) —
+        # and OUTSIDE the runtime lock: PVC resolution does HTTP, and a
+        # slow apiserver must not stall PLEG/heartbeat/exec behind it
+        self.volumes.setup_pod(pod)
+        with self._lock:
+            if key in self._pods:
+                return  # a concurrent sync won; its volumes == ours
             procs: Dict[str, _Proc] = {}
             try:
                 for c in pod.spec.containers or []:

@@ -126,6 +126,12 @@ class VolumeManager:
             return self._attach("gce", src.gce_persistent_disk.pd_name), True
         raise VolumeError(f"PV {pv_name!r}: no supported source")
 
+    def _in_attach_root(self, path: str) -> bool:
+        # separator-suffixed compare: a pod dir like <root>/attached_x
+        # (namespace "attached") must NOT match the attach root
+        return path == self.attach_root or \
+            path.startswith(self.attach_root + os.sep)
+
     # -- pod lifecycle ---------------------------------------------------------
 
     def setup_pod(self, pod: api.Pod) -> Dict[str, Dict[str, str]]:
@@ -171,11 +177,12 @@ class VolumeManager:
                         os.symlink(src, link)
                         entries[m.mount_path] = src
                     views[c.name] = entries
-            except VolumeError:
+            except (VolumeError, OSError):
                 # rollback: manager-created paths from earlier volumes of
-                # this failed setup must not leak
+                # this failed setup must not leak (OSError too — a failed
+                # symlink/mkdir must not skip it)
                 for path in owned:
-                    if not path.startswith(self.attach_root):
+                    if not self._in_attach_root(path):
                         shutil.rmtree(path, ignore_errors=True)
                 pod_dir = os.path.join(self.root, key.replace("/", "_"))
                 shutil.rmtree(os.path.join(pod_dir, "mounts"),
@@ -193,7 +200,7 @@ class VolumeManager:
             owned = self._owned.pop(key, [])
         pod_dir = os.path.join(self.root, key.replace("/", "_"))
         for path in owned:
-            if path.startswith(os.path.join(self.root, "attached")):
+            if self._in_attach_root(path):
                 continue  # attach bookkeeping outlives the pod
             shutil.rmtree(path, ignore_errors=True)
         shutil.rmtree(os.path.join(pod_dir, "mounts"), ignore_errors=True)
