@@ -57,8 +57,11 @@ class EndpointsController(Controller):
         if not (svc.spec and svc.spec.selector):
             return  # headless/manual endpoints are user-managed
         sel = labelsel.selector_from_map(svc.spec.selector)
-        ready, not_ready = [], []
-        sample_pod = None  # for named targetPort resolution
+        # named targetPorts resolve PER POD (reference FindPort per address):
+        # pods whose resolutions differ land in separate subsets, so
+        # heterogeneous backends (e.g. host-network processes on distinct
+        # ports) each stay reachable — grouped by the resolved port tuple
+        groups: dict = {}
         for pod in self.pod_informer.store.list():
             if pod.metadata.namespace != ns:
                 continue
@@ -74,17 +77,21 @@ class EndpointsController(Controller):
                 target_ref=api.ObjectReference(
                     kind="Pod", namespace=ns, name=pod.metadata.name,
                     uid=pod.metadata.uid))
+            port_key = tuple(_target_port(p, pod)
+                             for p in (svc.spec.ports or []))
+            ready, not_ready = groups.setdefault(port_key, ([], []))
             (ready if _is_ready(pod) else not_ready).append(addr)
-            sample_pod = pod
-        ports = [api.EndpointPort(name=p.name, protocol=p.protocol or "TCP",
-                                  port=_target_port(p, sample_pod))
-                 for p in (svc.spec.ports or [])]
         subsets = []
-        if ready or not_ready:
-            subsets = [api.EndpointSubset(
+        for port_key in sorted(groups):
+            ready, not_ready = groups[port_key]
+            ports = [api.EndpointPort(name=p.name,
+                                      protocol=p.protocol or "TCP",
+                                      port=port_key[i])
+                     for i, p in enumerate(svc.spec.ports or [])]
+            subsets.append(api.EndpointSubset(
                 addresses=ready or None,
                 not_ready_addresses=not_ready or None,
-                ports=ports or None)]
+                ports=ports or None))
         desired = api.Endpoints(
             metadata=api.ObjectMeta(name=name, namespace=ns),
             subsets=subsets or None)
@@ -129,8 +136,7 @@ def _is_ready(pod: api.Pod) -> bool:
 
 def _target_port(p: api.ServicePort, pod) -> int:
     """Resolve targetPort: int as-is, numeric string parsed, named port
-    looked up in the pod's container ports (reference FindPort). Assumes
-    homogeneous pods behind a service (one subset), like the common case."""
+    looked up in the pod's container ports (reference FindPort)."""
     tp = p.target_port
     if isinstance(tp, int):
         return tp

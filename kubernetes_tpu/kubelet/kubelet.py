@@ -250,10 +250,16 @@ class Kubelet:
         fresh.status.message = message
         fresh.status.host_ip = self._node_ip()
         if phase == api.POD_RUNNING:
-            self._ip_counter += 1
-            fresh.status.pod_ip = fresh.status.pod_ip or (
-                f"{self._pod_ip_base}.{self._ip_counter // 255}."
-                f"{self._ip_counter % 255 + 1}")
+            if self.runtime.fakes_network:
+                self._ip_counter += 1
+                fresh.status.pod_ip = fresh.status.pod_ip or (
+                    f"{self._pod_ip_base}.{self._ip_counter // 255}."
+                    f"{self._ip_counter % 255 + 1}")
+            else:
+                # real-process pods share the host network: their IP is the
+                # node's, so endpoints built from it are actually dialable
+                # (the proxy relay moves real bytes to them)
+                fresh.status.pod_ip = self._node_ip()
             fresh.status.start_time = fresh.status.start_time or now_iso()
             conds = [c for c in (fresh.status.conditions or [])
                      if c.type != api.POD_READY]

@@ -64,8 +64,10 @@ class LocalCluster:
         for i in range(self.nodes):
             self._spawn("kubernetes_tpu.kubelet", "--master", self.master_url,
                         "--node-name", f"node-{i:02d}", "--port", "0")
+        # userspace mode: the relay that actually moves bytes — a local
+        # cluster should have a working dataplane, not a rendered ruleset
         self._spawn("kubernetes_tpu.proxy", "--master", self.master_url,
-                    "--port", "0")
+                    "--port", "0", "--proxy-mode", "userspace")
         dns = self._spawn("kubernetes_tpu.dns", "--kube-master",
                           self.master_url, "--dns-port", "0",
                           pipe_stdout=True)

@@ -87,12 +87,12 @@ class _ProxySocket:
     (proxysocket.go tcpProxySocket)."""
 
     def __init__(self, svc_port_key: str, balancer: LoadBalancerRR,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", port: int = 0):
         self.key = svc_port_key
         self.balancer = balancer
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, 0))
+        self._listener.bind((host, port))
         self._listener.listen(64)
         self.port = self._listener.getsockname()[1]
         self._stop = threading.Event()
@@ -172,6 +172,7 @@ class UserspaceProxier:
         self.client = client
         self.balancer = LoadBalancerRR()
         self._sockets: Dict[str, _ProxySocket] = {}
+        self._node_sockets: Dict[str, _ProxySocket] = {}
         self._lock = threading.Lock()
         self.svc_informer = Informer(ListWatch(client, "services"))
         self.ep_informer = Informer(ListWatch(client, "endpoints"))
@@ -200,15 +201,35 @@ class UserspaceProxier:
                 pkey = f"{key}:{port.name or port.port}"
                 # same endpoint-selection semantics as the iptables compiler
                 addrs = _ready_addresses(endpoints.get(key), port.name)
-                want[pkey] = (addrs, spec.session_affinity == "ClientIP")
+                node_port = (port.node_port
+                             if spec.type in ("NodePort", "LoadBalancer")
+                             else 0)
+                want[pkey] = (addrs, spec.session_affinity == "ClientIP",
+                              node_port)
         with self._lock:
             for pkey in list(self._sockets):
                 if pkey not in want:
                     self._sockets.pop(pkey).stop()
-            for pkey, (addrs, affinity) in want.items():
+            for pkey in list(self._node_sockets):
+                if pkey not in want or not want[pkey][2] \
+                        or self._node_sockets[pkey].port != want[pkey][2]:
+                    # gone, un-NodePorted, or REALLOCATED: the old listener
+                    # must close (a changed nodePort re-opens below)
+                    self._node_sockets.pop(pkey).stop()
+            for pkey, (addrs, affinity, node_port) in want.items():
                 self.balancer.set_endpoints(pkey, addrs, affinity)
                 if pkey not in self._sockets:
                     self._sockets[pkey] = _ProxySocket(pkey, self.balancer)
+                # NodePort services additionally listen on the actual node
+                # port (reference: the userspace proxier's nodePort socket,
+                # proxier.go openNodePort) — `curl node:nodePort` is real
+                if node_port and pkey not in self._node_sockets:
+                    try:
+                        self._node_sockets[pkey] = _ProxySocket(
+                            pkey, self.balancer, port=node_port)
+                    except OSError as e:
+                        log.warning("nodePort %d for %s: %s",
+                                    node_port, pkey, e)
 
     def start(self):
         for inf in (self.svc_informer, self.ep_informer):
@@ -238,6 +259,10 @@ class UserspaceProxier:
         with self._lock:
             for s in self._sockets.values():
                 s.stop()
+            for s in self._node_sockets.values():
+                s.stop()
+            self._sockets.clear()
+            self._node_sockets.clear()
             self._sockets.clear()
 
 
