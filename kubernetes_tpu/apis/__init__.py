@@ -12,6 +12,7 @@ from kubernetes_tpu.apis import (  # noqa: F401  (import = register in scheme)
     batch,
     componentconfig,
     extensions,
+    federation,
     policy,
     rbac,
 )
@@ -24,4 +25,5 @@ GROUPS = {
     "policy": "policy/v1alpha1",
     "rbac.authorization.k8s.io": "rbac.authorization.k8s.io/v1alpha1",
     "componentconfig": "componentconfig/v1alpha1",
+    "federation": "federation/v1beta1",
 }

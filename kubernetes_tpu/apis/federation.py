@@ -1,0 +1,48 @@
+"""Federation API group (ubernetes).
+
+Parity target: reference federation/apis/federation — the Cluster
+resource: a member control plane registered with the federation by its
+API endpoint, with a reachability condition the federation controller
+maintains (federation/apis/federation/types.go Cluster/ClusterStatus).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from kubernetes_tpu.api.serialization import scheme
+from kubernetes_tpu.api.types import ObjectMeta
+
+GROUP = "federation"
+GROUP_VERSION = "federation/v1beta1"
+
+CLUSTER_READY = "Ready"
+
+
+@dataclass
+class ClusterSpec:
+    server_address: str = ""  # host:port of the member apiserver
+
+
+@dataclass
+class ClusterCondition:
+    type: str = ""            # Ready
+    status: str = ""          # True/False/Unknown
+    reason: str = ""
+    last_probe_time: Optional[str] = None
+
+
+@dataclass
+class ClusterStatus:
+    conditions: Optional[List[ClusterCondition]] = None
+
+
+@dataclass
+class Cluster:
+    metadata: Optional[ObjectMeta] = None
+    spec: Optional[ClusterSpec] = None
+    status: Optional[ClusterStatus] = None
+
+
+scheme.add_known_type(GROUP_VERSION, "Cluster", Cluster)

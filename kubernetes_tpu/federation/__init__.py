@@ -1,0 +1,17 @@
+"""Federation (ubernetes): one control plane fronting member clusters.
+
+Parity target: reference federation/ — the federated apiserver + cluster
+registry + federation controller (federation/cmd/*,
+federation/pkg/federation-controller). The federation control plane here
+IS a normal APIServer (it serves the same resource map plus the
+federation group's Cluster registry); the FederationSyncController does
+the ubernetes work: health-checks member clusters, propagates federated
+objects to every ready member, reconciles drift and deletions, and
+aggregates member status back up.
+"""
+
+from kubernetes_tpu.federation.controller import (
+    ClusterHealthController, FederationSyncController,
+)
+
+__all__ = ["ClusterHealthController", "FederationSyncController"]

@@ -1,0 +1,47 @@
+"""Federation entrypoint: python -m kubernetes_tpu.federation
+
+The federated control plane (reference federation/cmd): a full APIServer
+(same resource map + the federation group's Cluster registry) plus the
+cluster-health and federation-sync controllers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.federation import (
+    ClusterHealthController, FederationSyncController,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="federation-apiserver")
+    p.add_argument("--bind-address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    a = p.parse_args(argv)
+
+    server = APIServer(host=a.bind_address, port=a.port).start()
+    print(f"federation apiserver listening on "
+          f"http://{a.bind_address}:{server.port}", flush=True)
+    client = RESTClient.for_server(server, user_agent="federation")
+    health = ClusterHealthController(client)
+    health.start()
+    sync = FederationSyncController(client)
+    sync.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a_: stop.set())
+    signal.signal(signal.SIGINT, lambda *a_: stop.set())
+    stop.wait()
+    sync.stop()
+    health.stop()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -222,6 +222,11 @@ def _register_group_resources():
                           rbac.ClusterRoleBinding, namespaced=False,
                           api_version=rbac.GROUP_VERSION))
 
+    from kubernetes_tpu.apis import federation
+    _register(ResourceDef("clusters", "Cluster", federation.Cluster,
+                          namespaced=False,
+                          api_version=federation.GROUP_VERSION))
+
 
 _register_group_resources()
 
