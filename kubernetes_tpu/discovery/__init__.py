@@ -1,0 +1,12 @@
+"""kubernetes-discovery: the API discovery/aggregation proxy.
+
+Parity target: reference cmd/kubernetes-discovery — one endpoint fronting
+several API servers (e.g. the core plane and the federation plane):
+/apis merges every upstream's group list, and resource requests route to
+the upstream that serves their group. Clients configure one server and
+see the union.
+"""
+
+from kubernetes_tpu.discovery.proxy import DiscoveryProxy
+
+__all__ = ["DiscoveryProxy"]

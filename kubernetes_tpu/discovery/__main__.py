@@ -1,0 +1,37 @@
+"""kubernetes-discovery entrypoint: python -m kubernetes_tpu.discovery
+
+One endpoint fronting several API servers; --server may repeat (first is
+the primary/core plane).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from kubernetes_tpu.discovery import DiscoveryProxy
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kubernetes-discovery")
+    p.add_argument("--server", action="append", required=True,
+                   help="upstream apiserver host:port (repeatable; first "
+                        "is primary)")
+    p.add_argument("--bind-address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    a = p.parse_args(argv)
+
+    proxy = DiscoveryProxy(a.server, host=a.bind_address, port=a.port).start()
+    print(f"discovery proxy listening on "
+          f"http://{a.bind_address}:{proxy.port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a_: stop.set())
+    signal.signal(signal.SIGINT, lambda *a_: stop.set())
+    stop.wait()
+    proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

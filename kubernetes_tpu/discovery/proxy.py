@@ -1,0 +1,238 @@
+"""The discovery proxy server.
+
+Routing (reference cmd/kubernetes-discovery discoverysummarizer +
+the aggregation pattern it grew into):
+
+  GET /apis          union of every upstream's APIGroupList
+  GET /api           the primary upstream's core versions
+  /api/...           forwarded to the primary upstream
+  /apis/<group>/...  forwarded to the upstream that announced <group>
+                     (learned from its /apis at startup and refreshed
+                     when an unknown group arrives)
+  /healthz           503 until every upstream answers, then 200
+
+Forwarding is transparent at the HTTP layer: method, query string, body,
+and content-type travel as-is, so watches stream through chunk by chunk.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import urlparse
+
+import http.client
+
+from kubernetes_tpu.utils.nethost import parse_host_port
+
+
+class _Upstream:
+    def __init__(self, address: str):
+        self.host, self.port = parse_host_port(address)
+        self.address = address
+
+    def conn(self, timeout: float = 30.0) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+
+    def get_json(self, path: str):
+        conn = self.conn(timeout=5)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                return None
+            return json.loads(data)
+        finally:
+            conn.close()
+
+
+class DiscoveryProxy:
+    """One socket fronting N API servers; the first is primary (core)."""
+
+    def __init__(self, upstream_addresses: List[str], host: str = "127.0.0.1",
+                 port: int = 0):
+        if not upstream_addresses:
+            raise ValueError("at least one upstream required")
+        self.upstreams = [_Upstream(a) for a in upstream_addresses]
+        self._host = host
+        self._port = port
+        self._httpd = None
+        self._thread = None
+        self._lock = threading.Lock()
+        self._group_map: Dict[str, _Upstream] = {}
+
+    # -- group learning --------------------------------------------------------
+
+    def _refresh_groups(self) -> None:
+        mapping: Dict[str, _Upstream] = {}
+        for up in self.upstreams:
+            doc = up.get_json("/apis")
+            for g in (doc or {}).get("groups", []):
+                # first upstream serving a group wins (primary precedence)
+                mapping.setdefault(g.get("name", ""), up)
+        with self._lock:
+            self._group_map = mapping
+
+    def _upstream_for_group(self, group: str) -> Optional[_Upstream]:
+        with self._lock:
+            up = self._group_map.get(group)
+        if up is None:
+            self._refresh_groups()
+            with self._lock:
+                up = self._group_map.get(group)
+        return up
+
+    def merged_groups(self) -> dict:
+        groups, seen = [], set()
+        for up in self.upstreams:
+            doc = up.get_json("/apis")
+            for g in (doc or {}).get("groups", []):
+                name = g.get("name", "")
+                if name not in seen:
+                    seen.add(name)
+                    groups.append(g)
+        return {"kind": "APIGroupList", "groups": groups}
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "not started"
+        return self._httpd.server_address[1]
+
+    def start(self) -> "DiscoveryProxy":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send_json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self):
+                path = urlparse(self.path).path
+                if path == "/healthz":
+                    for up in outer.upstreams:
+                        try:
+                            ok = up.get_json("/api") is not None
+                        except Exception:
+                            ok = False
+                        if not ok:
+                            return self._send_json(
+                                503, {"status": "unhealthy",
+                                      "upstream": up.address})
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/apis" and self.command == "GET":
+                    return self._send_json(200, outer.merged_groups())
+                if path.startswith("/apis/"):
+                    group = path.split("/", 3)[2]
+                    up = outer._upstream_for_group(group)
+                    if up is None:
+                        return self._send_json(
+                            404, {"kind": "Status", "code": 404,
+                                  "reason": "NotFound",
+                                  "message": f"no upstream serves group "
+                                             f"{group!r}"})
+                    return self._forward(up)
+                # core API + everything else: the primary upstream
+                return self._forward(outer.upstreams[0])
+
+            def _forward(self, up: _Upstream):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else None
+                # watches idle between events; the upstream heartbeats
+                # every ~30s, so 120s only trips on a truly dead upstream
+                conn = up.conn(timeout=120)
+                started = False
+                try:
+                    headers = {}
+                    for h in ("Content-Type", "Accept", "Authorization"):
+                        if self.headers.get(h):
+                            headers[h] = self.headers[h]
+                    conn.request(self.command, self.path, body=body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    started = True
+                    self.send_response(resp.status)
+                    chunked = (resp.getheader("Transfer-Encoding", "")
+                               .lower() == "chunked")
+                    ctype = resp.getheader("Content-Type")
+                    if ctype:
+                        self.send_header("Content-Type", ctype)
+                    if chunked:
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        # stream watch frames through as they arrive
+                        while True:
+                            chunk = resp.read1(65536)
+                            if not chunk:
+                                self.wfile.write(b"0\r\n\r\n")
+                                break
+                            self.wfile.write(
+                                f"{len(chunk):x}\r\n".encode() + chunk
+                                + b"\r\n")
+                            self.wfile.flush()
+                    else:
+                        data = resp.read()
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                except OSError as e:
+                    if started:
+                        # mid-stream failure: a second status line would
+                        # corrupt the chunked body — close; the client's
+                        # short read triggers its re-list/retry path
+                        try:
+                            self.wfile.write(b"0\r\n\r\n")
+                        except OSError:
+                            pass
+                        self.close_connection = True
+                        return
+                    try:
+                        self._send_json(502, {
+                            "kind": "Status", "code": 502,
+                            "reason": "BadGateway",
+                            "message": f"upstream {up.address}: {e}"})
+                    except OSError:
+                        pass
+                finally:
+                    conn.close()
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _route
+
+        class Server(ThreadingHTTPServer):
+            request_queue_size = 128
+
+        self._httpd = Server((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="discovery-proxy", daemon=True)
+        self._thread.start()
+        self._refresh_groups()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -24,6 +24,7 @@ from kubernetes_tpu.apis import federation as fedapi
 from kubernetes_tpu.client import Informer, ListWatch, RESTClient
 from kubernetes_tpu.client.rest import ApiError
 from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.utils.nethost import parse_host_port
 from kubernetes_tpu.utils.timeutil import now_iso
 
 log = logging.getLogger("federation")
@@ -41,13 +42,9 @@ ANN_FEDERATED_BY = "federation.kubernetes.io/managed-by"
 
 
 def _member_client(cluster: fedapi.Cluster) -> RESTClient:
-    addr = cluster.spec.server_address if cluster.spec else ""
-    host, _, port = addr.rpartition(":")
-    if not port.isdigit():
-        # "localhost" or garbage: default port, whole string is the host
-        host, port = addr, "8080"
-    return RESTClient(host=host or "127.0.0.1", port=int(port),
-                      user_agent="federation-sync")
+    host, port = parse_host_port(
+        cluster.spec.server_address if cluster.spec else "")
+    return RESTClient(host=host, port=port, user_agent="federation-sync")
 
 
 def _is_ready(cluster: fedapi.Cluster) -> bool:
@@ -82,10 +79,9 @@ class ClusterHealthController(Controller):
         reason = "ProbeFailed"
         try:
             import http.client as hc
-            addr = cluster.spec.server_address if cluster.spec else ""
-            host, _, port = addr.rpartition(":")
-            conn = hc.HTTPConnection(host or "127.0.0.1",
-                                     int(port or 8080), timeout=3)
+            host, port = parse_host_port(
+                cluster.spec.server_address if cluster.spec else "")
+            conn = hc.HTTPConnection(host, port, timeout=3)
             try:
                 conn.request("GET", "/healthz")
                 resp = conn.getresponse()
@@ -101,19 +97,17 @@ class ClusterHealthController(Controller):
             type=fedapi.CLUSTER_READY,
             status=api.CONDITION_TRUE if ready else api.CONDITION_FALSE,
             reason=reason, last_probe_time=now_iso())
-        cur = cluster.status.conditions if cluster.status else None
-        cur_status = next((c.status for c in (cur or [])
-                           if c.type == fedapi.CLUSTER_READY), None)
-        if cur_status != cond.status:
-            enc = scheme.encode(fedapi.Cluster(
-                status=fedapi.ClusterStatus(conditions=[cond])))
-            try:
-                self.fed.patch("clusters", key,
-                               {"status": enc.get("status")},
-                               patch_type=self.fed.MERGE_PATCH)
-            except ApiError as e:
-                if not e.is_not_found:
-                    raise
+        # every probe refreshes the condition (the reference updates
+        # lastProbeTime each cycle — a stale timestamp is indistinguishable
+        # from a dead controller)
+        enc = scheme.encode(fedapi.Cluster(
+            status=fedapi.ClusterStatus(conditions=[cond])))
+        try:
+            self.fed.patch("clusters", key, {"status": enc.get("status")},
+                           patch_type=self.fed.MERGE_PATCH)
+        except ApiError as e:
+            if not e.is_not_found:
+                raise
         # periodic re-probe regardless of events
         self.arm_resync(key, self.probe_period)
 
@@ -165,6 +159,7 @@ class FederationSyncController(Controller):
         # keyed by (cluster name, address): a re-registered cluster on a
         # new port must not keep dialing the dead one
         self._clients: Dict[tuple, RESTClient] = {}
+        self._delete_retries: Dict[str, int] = {}
 
     @staticmethod
     def _key(resource: str, obj) -> str:
@@ -228,9 +223,19 @@ class FederationSyncController(Controller):
                     log.info("federation: deleted %s %s from %s",
                              resource, store_key, cname)
             if self._any_unready():
-                # an unready member may still hold a copy: keep retrying
-                # until every registered cluster has been swept
-                self.arm_resync(key, self.resync_period)
+                # an unready member may still hold a copy: retry for a
+                # bounded window (a permanently-dead registered cluster
+                # must not pin every deleted key's timer forever)
+                tries = self._delete_retries.get(key, 0) + 1
+                if tries <= 30:
+                    self._delete_retries[key] = tries
+                    self.arm_resync(key, self.resync_period)
+                else:
+                    log.warning("federation: giving up delete sweep of %s "
+                                "(unready member remains)", key)
+                    self._delete_retries.pop(key, None)
+            else:
+                self._delete_retries.pop(key, None)
             return
         desired = self._desired(fed_obj)
         agg = self.resources.get(resource)
@@ -258,6 +263,9 @@ class FederationSyncController(Controller):
                 merged = deep_copy(desired)
                 merged.metadata.resource_version = \
                     existing.metadata.resource_version
+                if hasattr(merged, "status"):
+                    # reconcile the SPEC; the member's status is its own
+                    merged.status = existing.status
                 client.update(resource, merged, ns)
                 log.info("federation: updated %s %s in %s",
                          resource, store_key, cname)
@@ -284,14 +292,19 @@ class FederationSyncController(Controller):
         return d
 
     def _specs_match(self, resource, desired, existing) -> bool:
-        enc_d = scheme.encode(desired).get("spec")
-        enc_e = scheme.encode(existing).get("spec")
-        if resource == "services" and isinstance(enc_e, dict):
-            enc_e = dict(enc_e)
-            enc_e.pop("clusterIP", None)
-            if isinstance(enc_d, dict):
-                enc_d = dict(enc_d)
-                enc_d.pop("clusterIP", None)
+        # compare the full propagated payload, not just .spec — Secrets and
+        # ConfigMaps carry their state in `data`, and a rotated federated
+        # secret MUST reach members
+        def payload(obj):
+            enc = scheme.encode(obj)
+            return {k: v for k, v in enc.items()
+                    if k not in ("metadata", "status", "kind", "apiVersion")}
+        enc_d, enc_e = payload(desired), payload(existing)
+        if resource == "services":
+            for enc in (enc_d, enc_e):
+                if isinstance(enc.get("spec"), dict):
+                    enc["spec"] = dict(enc["spec"])
+                    enc["spec"].pop("clusterIP", None)
         return enc_d == enc_e
 
     def _aggregate_status(self, resource, fed_obj, agg, totals) -> None:

@@ -1,0 +1,21 @@
+"""host:port parsing shared by everything that dials a configured address
+(federation member clients, cluster health probes, the discovery proxy).
+One tolerant parse instead of three divergent hand-rolled ones."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def parse_host_port(address: str, default_port: int = 8080) -> Tuple[str, int]:
+    """"host:port" -> (host, port); a bare host (or empty/garbage port)
+    gets the default port; an empty host becomes loopback. Scheme prefixes
+    (http://) are tolerated and stripped."""
+    addr = address or ""
+    if "//" in addr:
+        addr = addr.split("//", 1)[1]
+    addr = addr.rstrip("/")
+    host, _, port = addr.rpartition(":")
+    if not port.isdigit():
+        host, port = addr, str(default_port)
+    return host or "127.0.0.1", int(port)
