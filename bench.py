@@ -233,7 +233,12 @@ def _reexec_cpu(reason: str):
 def run_with_timeout(fn, seconds, stage):
     """Run fn() on a daemon thread; (True, value) or raises on error; a hang
     past `seconds` re-execs the whole bench on CPU (the thread can't be
-    killed, but a fresh interpreter can)."""
+    killed, but a fresh interpreter can).
+
+    Deliberately NOT ops/watchdog.run_stages: the scheduler's watchdog
+    converts a hang into an in-process error and falls back, but a bench
+    process that hit a backend hang is not trustworthy for further timing —
+    the only honest recovery is a fresh interpreter pinned to CPU."""
     import threading
 
     box = {}
@@ -305,6 +310,44 @@ def init_backend(max_tries=3):
     _reexec_cpu(f"TPU init failed {max_tries}x: {last_err!r}")
 
 
+def pipeline_breakdown():
+    """Per-stage timing + compile-cache ledger + stage-timeout counts,
+    sourced from the metrics registry — the SAME series every component's
+    /metrics serves, so the bench's breakdown and production observability
+    cannot drift apart. Stages: tensorize / upload / compile / solve (from
+    scheduler_stage_seconds) and bind (from the binding-latency histogram);
+    compile-cache events carry the machine-feature fingerprint that keys
+    the persistent cache (the round-5 AOT-mismatch failure mode, now a
+    visible label)."""
+    from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+    stages = {}
+    for lk, (cnt, total) in METRICS.hist_stats("scheduler_stage_seconds").items():
+        stage = dict(lk).get("stage", "?")
+        stages[stage] = {"count": int(cnt), "total_seconds": round(total, 4)}
+    bind_count, bind_total = 0, 0.0
+    for lk, (cnt, total) in METRICS.hist_stats(
+            "scheduler_binding_latency_seconds").items():
+        bind_count += int(cnt)
+        bind_total += total
+    if bind_count:
+        stages["bind"] = {"count": bind_count,
+                          "total_seconds": round(bind_total, 4)}
+    cache = []
+    for lk, v in sorted(METRICS.counter_series(
+            "compile_cache_events_total").items()):
+        entry = dict(lk)
+        entry["count"] = int(v)
+        cache.append(entry)
+    out = {"stages": stages, "compile_cache": cache}
+    timeouts = {dict(lk).get("stage", "?"): int(v)
+                for lk, v in METRICS.counter_series(
+                    "scheduler_stage_timeout_total").items()}
+    if timeouts:
+        out["stage_timeouts"] = timeouts
+    return out
+
+
 def fail_json(stage, err, **detail):
     print(json.dumps({
         "metric": METRIC,
@@ -312,6 +355,7 @@ def fail_json(stage, err, **detail):
         "unit": "pods/s",
         "vs_baseline": 0.0,
         "error": {"stage": stage, "exception": repr(err), **detail},
+        "pipeline": pipeline_breakdown(),
     }))
 
 
@@ -475,15 +519,19 @@ def restart_probe() -> None:
         nodes, existing, pending, services = build_cluster()
         args = make_plugin_args(nodes,
                                 service_lister=ListServiceLister(services))
+        from kubernetes_tpu.utils import platform as plat
         ct = Tensorizer(plugin_args=args).build(nodes, existing, pending)
         arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
         t_pre = time.perf_counter()
+        cc_before = plat.compile_cache_snapshot()
         out = np.asarray(_schedule_jit(arrays, ct.n_zones, Weights(),
                                        features_of(ct)))
         t_done = time.perf_counter()
+        cc_event = plat.record_compile_cache_event(cc_before)
         print(json.dumps({
             "restart_to_first_schedule_seconds": round(t_done - _T0, 1),
             "compile_plus_run_seconds": round(t_done - t_pre, 1),
+            "compile_cache": cc_event,
             "scheduled": int((out[: ct.n_real_pods] >= 0).sum()),
             "device": str(devs[0]),
         }))
@@ -522,12 +570,16 @@ def main():
     from kubernetes_tpu.ops.tensorize import Tensorizer
     from kubernetes_tpu.scheduler.batch import ListServiceLister, make_plugin_args
 
+    from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
     nodes, existing, pending, services = build_cluster()
     t_built = time.perf_counter()
 
     args = make_plugin_args(nodes, service_lister=ListServiceLister(services))
     ct = Tensorizer(plugin_args=args).build(nodes, existing, pending)
     t_tensorized = time.perf_counter()
+    METRICS.observe("scheduler_stage_seconds", t_tensorized - t_built,
+                    stage="tensorize")
     print(f"bench: tensorized in {t_tensorized - t_built:.1f}s; "
           f"device={devs[0]}", file=sys.stderr)
 
@@ -543,6 +595,8 @@ def main():
                   tensorize_seconds=round(t_tensorized - t_built, 1))
         return
     t_upload = time.perf_counter()
+    METRICS.observe("scheduler_stage_seconds", t_upload - t_tensorized,
+                    stage="upload")
 
     weights = Weights()
     feats = features_of(ct)
@@ -559,12 +613,18 @@ def main():
         return a
 
     try:
+        from kubernetes_tpu.utils import platform as plat
+
         def compile_and_run():
             out = _schedule_jit(arrays, ct.n_zones, weights, feats)
             # host materialization is the sync barrier (see module docstring)
             return np.asarray(out)
+        cc_before = plat.compile_cache_snapshot()
         res_full = run_with_timeout(compile_and_run, 900, "kernel compile")
         t_compiled = time.perf_counter()
+        plat.record_compile_cache_event(cc_before)
+        METRICS.observe("scheduler_stage_seconds", t_compiled - t_upload,
+                        stage="compile")
 
         def steady_state():
             # per-run: median of n_runs distinct dispatches, each materialized
@@ -574,7 +634,9 @@ def main():
                 jax.block_until_ready(a["used0"])  # perturbation off the clock
                 t0 = time.perf_counter()
                 np.asarray(_schedule_jit(a, ct.n_zones, weights, feats))
-                runs.append(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                METRICS.observe("scheduler_stage_seconds", dt, stage="solve")
+                runs.append(dt)
             # cross-check: K back-to-back distinct dispatches, all
             # materialized at the end; total/K bounds per-dispatch time
             ks = list(range(n_runs + 1, 2 * n_runs + 1))
@@ -664,6 +726,9 @@ def main():
                          for k, v in feats._asdict().items()},
         },
     }
+    # per-stage pipeline breakdown + compile-cache ledger, straight from the
+    # metrics registry (includes the e2e run's scheduler-recorded stages)
+    result["detail"]["pipeline"] = pipeline_breakdown()
     if e2e is not None:
         result["detail"]["e2e"] = e2e
     if restart is not None:

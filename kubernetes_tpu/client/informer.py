@@ -15,10 +15,12 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from kubernetes_tpu.client.cache import ThreadSafeStore, meta_namespace_key
 from kubernetes_tpu.client.reflector import ListWatch, Reflector
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 log = logging.getLogger("informer")
 
@@ -29,8 +31,10 @@ class Informer:
                  relist_backoff: float = 1.0):
         self.store = ThreadSafeStore(indexers)
         self.key = key_func
+        self.resource = getattr(lw, "resource", "")
         self._handlers: List[dict] = []
         self._events: "queue.Queue" = queue.Queue()
+        self._lag_stamped = 0.0
         self.reflector = Reflector(lw, self._Sink(self),
                                    relist_backoff=relist_backoff)
         self._dispatch_thread: Optional[threading.Thread] = None
@@ -51,26 +55,26 @@ class Informer:
             for k, o in keyed.items():
                 prev = old.get(k)
                 if prev is None:
-                    inf._events.put(("add", None, o))
+                    inf._events.put(("add", None, o, time.monotonic()))
                 else:
-                    inf._events.put(("update", prev, o))
+                    inf._events.put(("update", prev, o, time.monotonic()))
             for k, prev in old.items():
                 if k not in keyed and prev is not None:
-                    inf._events.put(("delete", prev, None))
+                    inf._events.put(("delete", prev, None, time.monotonic()))
 
         def add(self, obj):
             self.inf.store.add(self.inf.key(obj), obj)
-            self.inf._events.put(("add", None, obj))
+            self.inf._events.put(("add", None, obj, time.monotonic()))
 
         def update(self, obj):
             prev = self.inf.store.get(self.inf.key(obj))
             self.inf.store.update(self.inf.key(obj), obj)
-            self.inf._events.put(("update", prev, obj))
+            self.inf._events.put(("update", prev, obj, time.monotonic()))
 
         def delete(self, obj):
             prev = self.inf.store.get(self.inf.key(obj)) or obj
             self.inf.store.delete(self.inf.key(obj))
-            self.inf._events.put(("delete", prev, None))
+            self.inf._events.put(("delete", prev, None, time.monotonic()))
 
     def add_event_handler(self, on_add: Optional[Callable] = None,
                           on_update: Optional[Callable] = None,
@@ -105,7 +109,17 @@ class Informer:
             item = self._events.get()
             if item is None:
                 return
-            kind, old, new = item
+            kind, old, new, queued_at = item
+            # watch lag: store-apply -> handler dispatch. A growing gauge
+            # means handlers (or the work they enqueue) can't keep up with
+            # the watch stream for this resource. Sampled (>=10Hz), not
+            # per-event: a 30k-object relist must not take the registry
+            # lock 30k times on this hot thread.
+            now = time.monotonic()
+            if now - self._lag_stamped >= 0.1:
+                self._lag_stamped = now
+                METRICS.set_gauge("informer_watch_lag_seconds",
+                                  now - queued_at, resource=self.resource)
             for h in self._handlers:
                 try:
                     if kind == "add" and h["add"]:

@@ -1,99 +1,11 @@
-"""Event recording with client-side aggregation.
+"""Event recording — compatibility shim.
 
-Parity target: reference pkg/client/record — EventRecorder/EventBroadcaster
-(event.go:96,112) and the dedup/aggregation cache (events_cache.go:69-75):
-repeats of the same (object, reason, message) become a count bump via PUT
-instead of a new Event object, which is the spam control that keeps 5k-node
-clusters from melting the API server with "FailedScheduling" storms.
+The recorder moved to utils/events.py when it grew the reference's full
+correlation stack (aggregation + spam filter, events_cache.go); every
+existing `from kubernetes_tpu.client.record import EventRecorder` keeps
+working through this re-export.
 """
 
-from __future__ import annotations
-
-import logging
-import queue
-import threading
-import time
-from collections import OrderedDict
-from typing import Optional, Tuple
-
-from kubernetes_tpu.api import types as api
-from kubernetes_tpu.client.rest import ApiError, RESTClient
-from kubernetes_tpu.utils.timeutil import now_iso as _now_iso
-
-log = logging.getLogger("events")
-
-# aggregation cache cap (the reference's events_cache LRU analogue)
-MAX_AGGREGATION_ENTRIES = 4096
-
-
-class EventRecorder:
-    """`event(obj, type, reason, message)` — async fire-and-forget like the
-    reference broadcaster (a blocked event sink must never stall the
-    scheduler loop)."""
-
-    def __init__(self, client: RESTClient, source_component: str,
-                 source_host: str = ""):
-        self.client = client
-        self.source = api.EventSource(component=source_component, host=source_host)
-        # agg key -> (event name, count); LRU-capped so long-running
-        # components don't grow without bound
-        self._seen: "OrderedDict[Tuple, Tuple[str, int]]" = OrderedDict()
-        self._q: "queue.Queue" = queue.Queue()
-        self._thread = threading.Thread(target=self._pump, name="event-recorder",
-                                        daemon=True)
-        self._started = False
-        self._lock = threading.Lock()
-
-    def event(self, obj, etype: str, reason: str, message: str):
-        with self._lock:
-            if not self._started:
-                self._thread.start()
-                self._started = True
-        self._q.put((obj, etype, reason, message))
-
-    def flush(self, timeout: float = 5.0):
-        """Best-effort wait for queued events to be posted (tests)."""
-        deadline = time.monotonic() + timeout
-        while not self._q.empty() and time.monotonic() < deadline:
-            time.sleep(0.01)
-
-    def _pump(self):
-        while True:
-            obj, etype, reason, message = self._q.get()
-            try:
-                self._record(obj, etype, reason, message)
-            except Exception as e:
-                log.warning("event post failed: %s", e)
-
-    def _record(self, obj, etype: str, reason: str, message: str):
-        meta = obj.metadata
-        ref = api.ObjectReference(
-            kind=type(obj).__name__, namespace=meta.namespace, name=meta.name,
-            uid=meta.uid, resource_version=meta.resource_version)
-        agg_key = (ref.kind, ref.namespace, ref.name, etype, reason, message)
-        ns = meta.namespace or "default"
-        existing = self._seen.get(agg_key)
-        if existing is not None:
-            name, count = existing
-            try:
-                ev = self.client.get("events", name, ns)
-                ev.count = count + 1
-                ev.last_timestamp = _now_iso()
-                self.client.update("events", ev, ns)
-                self._seen[agg_key] = (name, count + 1)
-                self._seen.move_to_end(agg_key)
-                return
-            except ApiError:
-                pass  # fall through to create
-        now = _now_iso()
-        name = f"{meta.name}.{int(time.time() * 1e6):x}"
-        ev = api.Event(
-            metadata=api.ObjectMeta(name=name, namespace=ns),
-            involved_object=ref, reason=reason, message=message,
-            source=self.source, type=etype,
-            first_timestamp=now, last_timestamp=now, count=1)
-        self.client.create("events", ev, ns)
-        self._seen[agg_key] = (name, 1)
-        self._seen.move_to_end(agg_key)
-        while len(self._seen) > MAX_AGGREGATION_ENTRIES:
-            self._seen.popitem(last=False)
+from kubernetes_tpu.utils.events import (  # noqa: F401
+    AGGREGATED_PREFIX, MAX_AGGREGATION_ENTRIES, EventCorrelator, EventRecorder,
+)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import threading
 from typing import Iterator, Optional, Tuple
 from urllib.parse import quote
@@ -19,6 +20,9 @@ from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.serialization import from_dict, scheme, to_dict
 from kubernetes_tpu.registry.generic import RESOURCES
 from kubernetes_tpu.utils.flowcontrol import TokenBucket
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+log = logging.getLogger("restclient")
 
 
 class ApiError(Exception):
@@ -150,18 +154,25 @@ class RESTClient:
         self.ca_file = ca_file
         self.cert_file = cert_file
         self.key_file = key_file
+        # skipping verification is an EXPLICIT opt-in, never a default: a
+        # client that silently talks TLS-without-verification is
+        # indistinguishable from a MITM'd one. Loud when chosen, and every
+        # unverified connection ticks the tls_insecure_connections counter.
         self.insecure_skip_verify = insecure_skip_verify
+        if self.tls and insecure_skip_verify:
+            log.warning(
+                "TLS certificate verification DISABLED for %s:%s "
+                "(insecure_skip_verify=True)", host, port)
         self._limiter = TokenBucket(qps, burst)
         self._local = threading.local()
 
     @classmethod
     def for_server(cls, server, **kw) -> "RESTClient":
+        """Client for an in-process server. A secure server implies tls=True,
+        but NOT skip-verify: pass ca_file for verification or opt in to
+        insecure_skip_verify=True explicitly (it is counted + warned)."""
         if getattr(server, "secure", False):
             kw.setdefault("tls", True)
-            # convenience skip-verify ONLY when the caller supplied no CA —
-            # a provided ca_file means they asked for verification
-            if not kw.get("ca_file"):
-                kw.setdefault("insecure_skip_verify", True)
         return cls(host="127.0.0.1", port=server.port, **kw)
 
     # --- low-level -----------------------------------------------------------
@@ -186,6 +197,8 @@ class RESTClient:
 
     def _new_conn(self, timeout: float) -> http.client.HTTPConnection:
         if self.tls:
+            if self.insecure_skip_verify:
+                METRICS.inc("tls_insecure_connections")
             return http.client.HTTPSConnection(
                 self.host, self.port, timeout=timeout,
                 context=self._ssl_context())

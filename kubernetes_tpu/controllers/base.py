@@ -23,7 +23,8 @@ class Controller:
     name = "controller"
 
     def __init__(self, workers: int = 2):
-        self.queue = RateLimitingQueue()
+        # named queue -> workqueue depth/latency SLIs land per controller
+        self.queue = RateLimitingQueue(name=self.name)
         self.workers = workers
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()

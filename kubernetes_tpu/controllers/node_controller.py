@@ -16,6 +16,7 @@ from typing import Dict, Optional
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.serialization import deep_copy
 from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.record import EventRecorder
 from kubernetes_tpu.client.rest import ApiError
 from kubernetes_tpu.utils.flowcontrol import TokenBucket
 from kubernetes_tpu.utils.timeutil import now_iso
@@ -36,6 +37,7 @@ class NodeController:
         self.pod_eviction_timeout = pod_eviction_timeout
         self.eviction_limiter = TokenBucket(qps=eviction_qps, burst=1)
         self._clock = clock
+        self.recorder = EventRecorder(client, "node-controller")
         self.node_informer = Informer(ListWatch(client, "nodes"))
         self.pod_informer = Informer(ListWatch(client, "pods"))
         self._last_heartbeat: Dict[str, float] = {}
@@ -125,7 +127,10 @@ class NodeController:
             # while the CAS update 409s (swallowed; re-judged next tick)
             self.client.update_status("nodes", fresh)
         except ApiError:
-            pass
+            return  # flip lost the race: no event for a node that's alive
+        self.recorder.event(
+            node, "Normal", "NodeNotReady",
+            f"Node {node.metadata.name} status is now: NodeNotReady")
 
     def _evict_pods(self, node_name: str) -> bool:
         """Returns True when no pods remain bound to node_name."""
@@ -138,6 +143,10 @@ class NodeController:
             try:
                 self.client.delete("pods", pod.metadata.name,
                                    pod.metadata.namespace)
+                self.recorder.event(
+                    pod, "Normal", "NodeControllerEviction",
+                    f"Marking for deletion Pod {pod.metadata.name} from "
+                    f"Node {node_name}")
                 log.info("evicted pod %s/%s from dead node %s",
                          pod.metadata.namespace, pod.metadata.name, node_name)
             except ApiError as e:

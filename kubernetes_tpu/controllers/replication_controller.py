@@ -15,6 +15,7 @@ from kubernetes_tpu.api import labels as labelsel
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.serialization import deep_copy
 from kubernetes_tpu.client import Informer, ListWatch, RESTClient
+from kubernetes_tpu.client.record import EventRecorder
 from kubernetes_tpu.client.rest import ApiError
 from kubernetes_tpu.controllers.base import Controller
 from kubernetes_tpu.controllers.expectations import ControllerExpectations
@@ -33,6 +34,7 @@ class ReplicationManager(Controller):
         super().__init__(workers)
         self.client = client
         self.burst = burst_replicas
+        self.recorder = EventRecorder(client, "replication-controller")
         self.rc_informer = Informer(ListWatch(client, "replicationcontrollers"))
         self.pod_informer = Informer(ListWatch(client, "pods"))
         self.expectations = ControllerExpectations()
@@ -102,10 +104,12 @@ class ReplicationManager(Controller):
                 for _ in range(n):
                     self._create_pod(rc)
                     created += 1
-            except ApiError:
+            except ApiError as e:
                 # the watch will never deliver the failed + untried pods;
                 # un-expect all of them so the requeued sync isn't blocked
                 # for the full expectations timeout
+                self.recorder.event(rc, "Warning", "FailedCreate",
+                                    f"Error creating: {e}")
                 for _ in range(n - created):
                     self.expectations.creation_observed(key)
                 raise
@@ -117,6 +121,9 @@ class ReplicationManager(Controller):
             for i, p in enumerate(victims):
                 try:
                     self.client.delete("pods", p.metadata.name, ns)
+                    self.recorder.event(
+                        rc, "Normal", "SuccessfulDelete",
+                        f"Deleted pod: {p.metadata.name}")
                 except ApiError as e:
                     if e.is_not_found:
                         self.expectations.deletion_observed(key)
@@ -130,7 +137,9 @@ class ReplicationManager(Controller):
     def _create_pod(self, rc: api.ReplicationController):
         pod = pod_from_template("ReplicationController", rc,
                                 rc.spec.template or api.PodTemplateSpec())
-        self.client.create("pods", pod, rc.metadata.namespace)
+        created = self.client.create("pods", pod, rc.metadata.namespace)
+        self.recorder.event(rc, "Normal", "SuccessfulCreate",
+                            f"Created pod: {created.metadata.name}")
 
     def _update_status(self, rc: api.ReplicationController, pods: list):
         desired_status = len(pods)

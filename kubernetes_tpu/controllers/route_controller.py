@@ -35,8 +35,10 @@ class RouteController(Controller):
         self._cidr_lock = threading.Lock()
         # CIDRs handed out but possibly not yet visible in the informer
         # store: without this, two back-to-back node syncs both read the
-        # stale store and collide on the same subnet
-        self._issued: set = set()
+        # stale store and collide on the same subnet. Mapped to the node
+        # they were issued for, so a failed patch or a deleted node returns
+        # its subnet to the pool instead of leaking it forever.
+        self._issued: dict = {}  # cidr -> node name
         self.node_informer = Informer(ListWatch(client, "nodes"))
         self.node_informer.add_event_handler(
             on_add=lambda n: self.enqueue(n.metadata.name),
@@ -49,33 +51,64 @@ class RouteController(Controller):
         return {n.spec.pod_cidr for n in self.node_informer.store.list()
                 if n.spec and n.spec.pod_cidr}
 
-    def _allocate_cidr(self) -> str:
+    def _allocate_cidr(self, node_name: str) -> str:
         with self._cidr_lock:
-            used = self._used_cidrs() | self._issued
+            # a retry after an ambiguous patch failure reuses the subnet
+            # already issued to this node: if the lost write actually landed
+            # the store converges on the same value, and if it didn't, the
+            # pool doesn't shrink by one per retry
+            for s, n in self._issued.items():
+                if n == node_name:
+                    return s
+            visible = self._used_cidrs()
+            # issued entries that made it into the store are recorded on
+            # their nodes now; drop the guard so the map stays bounded
+            for s in [s for s in self._issued if s in visible]:
+                del self._issued[s]
+            used = visible | set(self._issued)
             for subnet in self.net.subnets(new_prefix=self.node_mask):
                 s = str(subnet)
                 if s not in used:
-                    self._issued.add(s)
+                    self._issued[s] = node_name
                     return s
         raise RuntimeError(f"cluster CIDR {self.net} exhausted")
+
+    def _release_issued(self, cidr: str = "", node: str = "") -> None:
+        with self._cidr_lock:
+            if cidr:
+                self._issued.pop(cidr, None)
+            if node:
+                for s in [s for s, n in self._issued.items() if n == node]:
+                    del self._issued[s]
 
     def sync(self, key: str) -> None:
         node = self.node_informer.store.get(key)
         if node is None:
-            # node gone: its route must go too (routecontroller.go reconcile)
+            # node gone: its route must go too (routecontroller.go
+            # reconcile), and any CIDR issued-but-unrecorded for it returns
+            # to the pool
+            self._release_issued(node=key)
             if key in self.cloud.list_routes():
                 self.cloud.delete_route(key)
                 log.info("deleted route for departed node %s", key)
             return
         cidr = node.spec.pod_cidr if node.spec else ""
         if not cidr:
-            cidr = self._allocate_cidr()
+            cidr = self._allocate_cidr(key)
             try:
                 self.client.patch("nodes", key,
                                   {"spec": {"podCIDR": cidr}})
-            except ApiError as e:
-                if e.is_not_found:
-                    return
+            except Exception as e:
+                # reclaim ONLY when the server provably rejected the write
+                # (4xx): a timeout/5xx/transport failure may have landed
+                # server-side, and reissuing that subnet to another node
+                # would overlap two pod CIDRs. Ambiguous failures keep the
+                # guard entry; it is pruned once the CIDR shows up in the
+                # store, or when this node is deleted.
+                if isinstance(e, ApiError) and 400 <= e.code < 500:
+                    self._release_issued(cidr=cidr)
+                    if e.is_not_found:
+                        return
                 raise
             log.info("allocated podCIDR %s to node %s", cidr, key)
         if self.cloud.list_routes().get(key) != cidr:

@@ -68,8 +68,19 @@ class ClusterHealthController(Controller):
         self.cluster_informer = Informer(ListWatch(fed_client, "clusters"))
         self.cluster_informer.add_event_handler(
             on_add=lambda c: self.enqueue(c.metadata.name),
-            on_update=lambda o, n: self.enqueue(n.metadata.name),
-            on_delete=lambda c: None)
+            on_update=self._cluster_changed,
+            on_delete=lambda c: self.disarm_resync(c.metadata.name))
+
+    def _cluster_changed(self, old, new):
+        """Enqueue only on SPEC change. Our own status patches come back as
+        update events; re-probing on them made the loop self-sustaining —
+        every probe's write triggered the next probe immediately, bypassing
+        probe_period entirely (round-5 ADVICE: 115 probes in 5 s). The
+        periodic re-probe is arm_resync's job."""
+        old_spec = scheme.encode(old).get("spec")
+        new_spec = scheme.encode(new).get("spec")
+        if old_spec != new_spec:
+            self.enqueue(new.metadata.name)
 
     def sync(self, key: str) -> None:
         cluster = self.cluster_informer.store.get(key)

@@ -146,6 +146,33 @@ def _describe_lines(resource: str, obj) -> List[str]:
     return lines
 
 
+def _event_lines(client, resource: str, obj) -> List[str]:
+    """Events involving this object (reference describe.go: every describer
+    ends with the object's event stream)."""
+    rd = RESOURCES.get(resource)
+    kind = rd.kind if rd else resource
+    m = obj.metadata or api.ObjectMeta()
+    # non-namespaced kinds' events land in "default" (the recorder's rule)
+    ns = m.namespace or "default"
+    try:
+        evs, _ = client.list(
+            "events", ns,
+            field_selector=f"involvedObject.kind={kind},"
+                           f"involvedObject.name={m.name}")
+    except ApiError:
+        return []
+    if not evs:
+        return []
+    lines = ["Events:", "  LastSeen\tCount\tFrom\tType\tReason\tMessage"]
+    for e in sorted(evs, key=lambda e: e.last_timestamp or ""):
+        src = e.source.component if e.source else ""
+        if e.source and e.source.host:
+            src += f", {e.source.host}"
+        lines.append(f"  {e.last_timestamp or ''}\t{e.count}\t{src}\t"
+                     f"{e.type}\t{e.reason}\t{e.message}")
+    return lines
+
+
 def cmd_describe(args) -> int:
     client = _client(args)
     pairs = res.parse_args(args.args)
@@ -153,7 +180,9 @@ def cmd_describe(args) -> int:
     chunks = []
     for resource, objs in blocks:
         for o in objs:
-            chunks.append("\n".join(_describe_lines(resource, o)))
+            lines = _describe_lines(resource, o)
+            lines += _event_lines(client, resource, o)
+            chunks.append("\n".join(lines))
     print("\n\n\n".join(chunks))
     return 0
 

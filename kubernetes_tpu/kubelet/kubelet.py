@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, Optional
 
 from kubernetes_tpu.api import fields as fieldsel
@@ -26,7 +27,8 @@ from kubernetes_tpu.kubelet.probe import ProbeManager
 from kubernetes_tpu.kubelet.runtime import FakeCadvisor, FakeRuntime, PodRuntime
 from kubernetes_tpu.scheduler.cache import NodeInfo
 from kubernetes_tpu.scheduler.predicates import PredicateFailure, general_predicates
-from kubernetes_tpu.utils.timeutil import now_iso
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+from kubernetes_tpu.utils.timeutil import now_iso, parse_iso
 
 log = logging.getLogger("kubelet")
 
@@ -63,6 +65,7 @@ class Kubelet:
         self._ip_counter = 0
         self._statuses: Dict[str, tuple] = {}  # key -> last written signature
         self._ready: Dict[str, bool] = {}      # key -> last probed readiness
+        self._pulled: set = set()              # keys with Pulled already emitted
         # pods WE declared terminal (evicted / died with restartPolicy=Never /
         # failed admission): a stale watch event still carrying phase=Running
         # must never restart them (the reference's status manager owns the
@@ -175,6 +178,9 @@ class Kubelet:
         """syncPod: admit -> run -> report (kubelet.go:1796)."""
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
         if pod.metadata.deletion_timestamp is not None:
+            if key in self.runtime.running():
+                self.recorder.event(pod, "Normal", "Killing",
+                                    f"Killing pod {pod.metadata.name}")
             self.runtime.kill_pod(key)
             return
         if key in self._terminal:
@@ -191,6 +197,22 @@ class Kubelet:
                                  message=err)
                 self.recorder.event(pod, "Warning", "FailedAdmission", err)
                 return
+            if key not in self._pulled:
+                # once per pod lifetime, not per start attempt: a FailedSync
+                # retry loop re-entering here every resync tick would drain
+                # the recorder's per-pod spam budget and silence later REAL
+                # events (Killing/Evicted)
+                self._pulled.add(key)
+                for c in (pod.spec.containers or []) if pod.spec else []:
+                    if c.image:
+                        # no image puller in this runtime: images are always
+                        # "present"; the event keeps the reference's
+                        # lifecycle trail (Pulled -> Started) readable in
+                        # kubectl describe
+                        self.recorder.event(
+                            pod, "Normal", "Pulled",
+                            f'Container image "{c.image}" already present '
+                            "on machine")
             try:
                 self.runtime.sync_pod(pod)
             except Exception as e:
@@ -205,6 +227,16 @@ class Kubelet:
                 return
             self.recorder.event(pod, "Normal", "Started",
                                 f"Started pod {pod.metadata.name}")
+            created = parse_iso(pod.metadata.creation_timestamp)
+            prior_start = bool(pod.status and pod.status.start_time)
+            if created is not None and not prior_start:
+                # the density-suite SLI: pod creation -> containers started
+                # (coarse: the API stamps are second-resolution). Gated on
+                # no prior status.start_time: a kubelet restart re-syncing
+                # long-running pods must not record pod AGE as startup
+                # latency and poison the histogram's tail
+                METRICS.observe("kubelet_pod_startup_latency_seconds",
+                                max(time.time() - created, 0.0))
             # pods with readiness probes start unready until the first
             # success; afterwards the probe loop owns this bit
             has_readiness = any(c.readiness_probe
@@ -308,6 +340,7 @@ class Kubelet:
             self.probes.forget_pod(key)
             self._statuses.pop(key, None)
             self._ready.pop(key, None)
+            self._pulled.discard(key)
             self._terminal.discard(key)  # a recreated name starts fresh
 
     def _resync(self):

@@ -1398,51 +1398,97 @@ class IncrementalTensorizer:
     def device_sync(self, ct: ClusterTensors, device=None):
         """jax-array view of the batch: node-side arrays re-upload only when
         their version bumped since the last sync (double-buffered on device —
-        the previous batch's buffers stay alive until replaced)."""
-        import jax
-        import jax.numpy as jnp
+        the previous batch's buffers stay alive until replaced). Staging
+        takes the mirror lock itself (reentrant), the transfer is
+        lock-free — see _stage_uploads."""
+        with self._lock:
+            plan = self._stage_uploads(ct)
+        return self._upload_staged(plan, device=device)
 
+    def _stage_uploads(self, ct: ClusterTensors) -> list:
+        """Under the mirror lock: decide what needs upload and snapshot the
+        dirty node-side arrays as PRIVATE host copies. The actual device
+        transfer (_upload_staged) then runs with NO lock held — a device
+        call that hangs must never be abandoned (watchdog) while holding
+        the lock every cache listener needs, and the copies make the
+        transfer immune to concurrent in-place listener mutation."""
         if not hasattr(self, "_dev_cache"):
             self._dev_cache: Dict[str, Tuple[int, object]] = {}
-        out = {}
-        uploaded = 0
+        plan = []
         for k, v in ct.arrays().items():
-            if v.dtype == np.float64:
-                v = v.astype(np.float32)
             if k in self._NODE_SIDE:
                 ver = self._versions.get(k, 0)
                 hit = self._dev_cache.get(k)
                 if hit is not None and hit[0] == ver:
-                    out[k] = hit[1]
+                    plan.append((k, None, None, hit[1]))
                     continue
-                arr = jnp.asarray(v)
-                if device is not None:
-                    arr = jax.device_put(arr, device)
-                self._dev_cache[k] = (ver, arr)
-                out[k] = arr
-                uploaded += v.nbytes
+                # private copy: node-side arrays ARE the live mirror and
+                # listeners mutate them in place (astype already copies)
+                copy = (v.astype(np.float32) if v.dtype == np.float64
+                        else v.copy())
+                plan.append((k, ver, copy, None))
             else:
-                arr = jnp.asarray(v)
-                if device is not None:
-                    arr = jax.device_put(arr, device)
-                out[k] = arr
-                uploaded += v.nbytes
+                if v.dtype == np.float64:
+                    v = v.astype(np.float32)
+                # pod-side / derived-fresh: built per batch, never mutated
+                # by listeners — safe to upload without a copy
+                plan.append((k, None, v, None))
+        return plan
+
+    def _upload_staged(self, plan: list, device=None):
+        """Device transfer of a staged plan; lock-free (see _stage_uploads)."""
+        import jax
+        import jax.numpy as jnp
+
+        out = {}
+        uploaded = 0
+        for k, ver, host, cached in plan:
+            if cached is not None:
+                out[k] = cached
+                continue
+            arr = jnp.asarray(host)
+            if device is not None:
+                arr = jax.device_put(arr, device)
+            if ver is not None:
+                self._dev_cache[k] = (ver, arr)
+            out[k] = arr
+            uploaded += host.nbytes
         self.last_upload_bytes = uploaded
         return out
 
     # --- the full incremental decision path -----------------------------------
 
     def schedule(self, pending: List[api.Pod], weights=None,
-                 device=None) -> List[Optional[str]]:
+                 device=None, stage=None) -> List[Optional[str]]:
         """build + device sync + kernel; returns node name (or None) per
-        pending pod, FIFO order — drop-in for scheduler.batch.tpu_batch."""
+        pending pod, FIFO order — drop-in for scheduler.batch.tpu_batch.
+
+        `stage(name, fn)` (ops/watchdog.run_stages hook) observes the
+        pipeline as named stages: tensorize -> upload -> compile|solve.
+        The mirror lock is held ONLY across host-side work (build + staging
+        private copies of the dirty arrays): the device-touching stages
+        (upload, solve) run lock-free, so a watchdog that abandons a hung
+        device call never strands the lock the cache listeners need —
+        which would deadlock the informer pipeline, a strictly worse wedge
+        than the hang being converted."""
         from kubernetes_tpu.ops.kernel import (
-            Weights, _schedule_jit, assignments_to_names, features_of,
+            Weights, assignments_to_names, dispatch, features_of,
         )
         weights = weights or Weights()
-        with self._lock:
-            ct = self.build(pending)
-            arrays = self.device_sync(ct, device=device)
-            n_zones, feats = ct.n_zones, features_of(ct)
-        out = np.asarray(_schedule_jit(arrays, n_zones, weights, feats))
+        run = stage or (lambda _n, fn: fn())
+
+        def _tensorize():
+            with self._lock:
+                ct = self.build(pending)
+                # feature flags must be derived under the same lock as the
+                # staged copies: ct aliases the live mirror, and a listener
+                # delta in between could make the static trace flags
+                # disagree with the uploaded arrays
+                return ct, self._stage_uploads(ct), features_of(ct)
+
+        ct, plan, feats = run("tensorize", _tensorize)
+        n_zones = ct.n_zones
+        arrays = run("upload", lambda: self._upload_staged(plan,
+                                                           device=device))
+        out = dispatch(arrays, n_zones, weights, feats, stage=stage)
         return assignments_to_names(out, ct)

@@ -746,14 +746,60 @@ def assignments_to_names(out: np.ndarray,
     return result
 
 
+# static dispatch keys already traced in this process: the first dispatch
+# for a key pays the XLA compile and is attributed to the "compile" stage
+# (and classified against the persistent compile cache); repeats are "solve"
+_DISPATCHED: set = set()
+
+
+def _dispatch_key(arrays: dict, n_zones: int, weights: Weights,
+                  feats: Features) -> tuple:
+    shapes = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                          for k, v in arrays.items()))
+    return shapes, n_zones, weights, feats
+
+
+def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
+             stage=None) -> np.ndarray:
+    """Run the jit'd solve with host materialization as the sync barrier.
+
+    `stage(name, fn)` (the watchdog/span hook, ops/watchdog.run_stages) sees
+    the dispatch as stage "compile" the first time a static shape is traced
+    — with a compile-cache hit/miss event recorded, fingerprint-labeled —
+    and as stage "solve" afterwards."""
+    from kubernetes_tpu.utils import platform as plat
+
+    key = _dispatch_key(arrays, n_zones, weights, feats)
+    first = key not in _DISPATCHED
+    name = "compile" if first else "solve"
+
+    def _run():
+        before = plat.compile_cache_snapshot() if first else None
+        out = np.asarray(_schedule_jit(arrays, n_zones, weights, feats))
+        if first:
+            plat.record_compile_cache_event(before)
+        return out
+
+    run = stage or (lambda _n, fn: fn())
+    out = run(name, _run)
+    _DISPATCHED.add(key)
+    return out
+
+
 def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
-                   device=None) -> List[Optional[str]]:
+                   device=None, stage=None) -> List[Optional[str]]:
     """Schedule a tensorized batch; returns node name (or None) per pending
     pod, FIFO order."""
     weights = weights or Weights()
     feats = features_of(ct)
-    arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
-    if device is not None:
-        arrays = jax.device_put(arrays, device)
-    out = np.asarray(_schedule_jit(arrays, ct.n_zones, weights, feats))
+    run = stage or (lambda _n, fn: fn())
+
+    def _upload():
+        arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
+        if device is not None:
+            arrays = jax.device_put(arrays, device)
+        return arrays
+
+    arrays = run("upload", _upload)
+    out = dispatch(arrays, ct.n_zones, weights, feats, stage=stage)
     return assignments_to_names(out, ct)

@@ -1,0 +1,127 @@
+"""Kernel-stage watchdogs: convert a hang into a labeled timeout.
+
+Round-5 postmortem: the flagship bench loaded an AOT compile-cache entry
+built for different machine features and the CPU fallback then sat wedged
+for 600 s with no indication of WHERE (tensorize? upload? compile? solve?).
+A hung XLA/axon call cannot be interrupted from Python, so the watchdog
+inverts control instead: the staged pipeline runs on a disposable daemon
+thread that records which named stage it is inside, and the CALLING thread
+enforces each stage's deadline.  On violation the caller gets a structured
+`StageTimeout` naming the stage (and the `scheduler_stage_timeout_total`
+counter ticks) while the zombie worker is abandoned — the scheduler then
+takes its normal device-error fallback path instead of wedging.
+
+Stage durations are exported to `scheduler_stage_seconds{stage=...}`, which
+is also where bench.py sources its per-stage breakdown.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+STAGE_METRIC = "scheduler_stage_seconds"
+TIMEOUT_METRIC = "scheduler_stage_timeout_total"
+
+# generous production defaults (bench.py historically used the same orders
+# of magnitude for its own hang guards); tests inject tiny ones. A None
+# deadline disarms the watchdog for that stage: tensorize is host-side
+# Python that runs WHILE HOLDING the mirror lock, so abandoning it on a
+# deadline would strand the lock every cache listener needs (the contract
+# below) — and a slow-but-progressing host build misclassified as a device
+# error would be a false degradation. The device-risk stages
+# (upload/compile/solve) run lock-free and stay deadlined.
+DEFAULT_DEADLINES: Dict[str, Optional[float]] = {
+    "tensorize": None,
+    "upload": 300.0,
+    "compile": 900.0,
+    "solve": 600.0,
+}
+DEFAULT_STAGE_DEADLINE = 600.0
+
+
+class StageTimeout(TimeoutError):
+    """A named pipeline stage blew its deadline. Subclasses TimeoutError so
+    the scheduler's failure classifier treats it as a (possibly transient)
+    device-side fault: backoff + sequential fallback, never a silent wedge."""
+
+    def __init__(self, stage: str, deadline: float):
+        self.stage = stage
+        self.deadline = deadline
+        super().__init__(
+            f"kernel stage {stage!r} exceeded its {deadline:g}s deadline")
+
+
+def run_stages(work: Callable, deadlines: Optional[Dict[str, float]] = None,
+               default_deadline: float = DEFAULT_STAGE_DEADLINE,
+               registry=METRICS, span=None, poll: float = 0.05):
+    """Run `work(stage)` on a daemon worker thread, where `stage(name, fn)`
+    executes fn as a named, deadlined, metered pipeline stage.
+
+    The caller blocks until the work completes (its result/exception
+    propagates) or the current stage exceeds its deadline — then a
+    StageTimeout is raised here and the worker is abandoned (a hung device
+    call cannot be killed; a labeled error beats a wedged scheduler).
+
+    CONTRACT: because a timed-out worker is abandoned mid-stage, a stage
+    that can hang (any device call) must not hold locks that other threads
+    need — an abandoned worker parked inside one would convert the hang
+    into a process-wide deadlock (see IncrementalTensorizer.schedule: the
+    mirror lock covers host-only staging; upload/solve run lock-free).
+
+    With `span` given, each stage also becomes a child span of it.
+    """
+    deadlines = deadlines or {}
+    state = {"stage": None, "since": 0.0}
+    state_lock = threading.Lock()
+    done = threading.Event()
+    box: dict = {}
+
+    def stage(name: str, fn: Callable):
+        child = span.child(name) if span is not None else None
+        with state_lock:
+            state["stage"] = name
+            state["since"] = time.monotonic()
+        t0 = time.perf_counter()
+        try:
+            return fn()
+        finally:
+            dt = time.perf_counter() - t0
+            if registry is not None:
+                registry.observe(STAGE_METRIC, dt, stage=name)
+            if child is not None:
+                child.finish()
+            with state_lock:
+                state["stage"] = None
+
+    def runner():
+        try:
+            box["value"] = work(stage)
+        except BaseException as e:  # surfaced to the caller below
+            box["err"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=runner, name="kernel-stages",
+                              daemon=True)
+    worker.start()
+    while not done.wait(poll):
+        with state_lock:
+            name, since = state["stage"], state["since"]
+        if name is None:
+            continue
+        limit = deadlines.get(name, default_deadline)
+        if limit is None:
+            continue  # explicitly disarmed (lock-holding host stage)
+        if time.monotonic() - since > limit:
+            if registry is not None:
+                registry.inc(TIMEOUT_METRIC, stage=name)
+            if span is not None:
+                span.attrs["timeout_stage"] = name
+            raise StageTimeout(name, limit)
+    if "err" in box:
+        raise box["err"]
+    return box["value"]

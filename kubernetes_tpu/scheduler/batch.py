@@ -127,7 +127,13 @@ def oracle_batch(nodes: List[api.Node], existing: List[api.Pod],
 
 def tpu_batch(nodes: List[api.Node], existing: List[api.Pod],
               pending: List[api.Pod], args: PluginArgs,
-              weights: Optional[Weights] = None) -> List[Optional[str]]:
-    """The TPU path: tensorize + device kernel."""
-    ct = Tensorizer(plugin_args=args).build(nodes, existing, pending)
-    return schedule_batch(ct, weights)
+              weights: Optional[Weights] = None,
+              stage=None) -> List[Optional[str]]:
+    """The TPU path: tensorize + device kernel. `stage(name, fn)` is the
+    watchdog/span hook (ops/watchdog.run_stages) naming the pipeline stages
+    tensorize -> upload -> compile|solve."""
+    run = stage or (lambda _n, fn: fn())
+    ct = run("tensorize",
+             lambda: Tensorizer(plugin_args=args).build(nodes, existing,
+                                                        pending))
+    return schedule_batch(ct, weights, stage=stage)

@@ -39,6 +39,8 @@ from kubernetes_tpu.scheduler.provider import (
 )
 from kubernetes_tpu.utils.flowcontrol import Backoff
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+from kubernetes_tpu.utils.timeutil import parse_iso
+from kubernetes_tpu.utils.trace import SpanTracker
 
 log = logging.getLogger("scheduler")
 
@@ -57,6 +59,14 @@ class ConfigFactory:
         self.cache = SchedulerCache(ttl=ASSUME_TTL)
         self.pending = FIFO()
         self.backoff = Backoff(initial=1.0, maximum=60.0)  # podBackoff
+        # per-pending-pod spans: informer delivery -> queue wait -> bind,
+        # correlated across the informer/batch/bind-pool threads
+        self.spans = SpanTracker()
+        # pods whose first delivery was already measured: retry deliveries
+        # (our own Unschedulable status writes echoing back) must not
+        # re-observe creation->delivery, which would fold scheduling and
+        # backoff time into the watch-lag SLI
+        self._delivered: set = set()
         self._informers = []
 
         # unassigned pods -> FIFO (spec.nodeName= ListWatch, factory.go:458-461)
@@ -122,6 +132,25 @@ class ConfigFactory:
 
     def _maybe_enqueue(self, pod: api.Pod):
         if self._responsible_for(pod) and not (pod.spec and pod.spec.node_name):
+            # span BEFORE the FIFO add: the scheduler loop may pop (and
+            # close the queue_wait stage) the instant the pod is queued
+            key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            if self.spans.current(key) is None:
+                sp = self.spans.start(key, "schedule_pod", pod=key)
+                created = parse_iso(pod.metadata.creation_timestamp)
+                if created is not None and key not in self._delivered:
+                    # creation -> FIRST informer delivery only (watch
+                    # fan-out lag; the iso stamps are second-resolution, so
+                    # this is coarse)
+                    if len(self._delivered) > 200_000:
+                        self._delivered.clear()
+                    self._delivered.add(key)
+                    lag = max(time.time() - created, 0.0)
+                    METRICS.observe("scheduler_informer_delivery_seconds", lag)
+                    sp.attrs["informer_delivery_seconds"] = round(lag, 3)
+            # if_idle: a watch echo for a pod mid-solve/bind must not
+            # clobber its live stage with a bogus queue_wait
+            self.spans.stage_if_idle(key, "queue_wait")
             self.pending.add(pod)
 
     # --- builders (CreateFromProvider/CreateFromConfig, factory.go:248-342) --
@@ -150,13 +179,15 @@ class ConfigFactory:
 
     def create_batch_from_provider(self, provider_name: str = DEFAULT_PROVIDER,
                                    batch_size: int = 4096, weights=None,
-                                   strict: bool = False):
+                                   strict: bool = False,
+                                   stage_deadlines=None):
         """The TPU-backed batch scheduler (scheduler/tpu.py) with the oracle
         from the same provider as its device-failure fallback."""
         from kubernetes_tpu.scheduler.tpu import create_batch_scheduler
         return create_batch_scheduler(self, provider_name,
                                       batch_size=batch_size, weights=weights,
-                                      strict=strict)
+                                      strict=strict,
+                                      stage_deadlines=stage_deadlines)
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -197,8 +228,16 @@ class Scheduler:
         self._schedule_pod(pod)
         return True
 
+    def _note_popped(self, pod: api.Pod) -> None:
+        """Close the pod's queue_wait span stage at FIFO pop, exporting the
+        wait into the queue-wait SLI histogram."""
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        self.f.spans.end_stage(key, metric="scheduler_pod_queue_wait_seconds",
+                               name="queue_wait")
+
     def _schedule_pod(self, pod: api.Pod) -> None:
         t_start = time.perf_counter()
+        self._note_popped(pod)
         try:
             info = self.f.cache.get_node_name_to_info_map()
             nodes = self.f.node_lister.list()
@@ -227,6 +266,8 @@ class Scheduler:
                          daemon=True).start()
 
     def _bind(self, pod: api.Pod, dest: str, t_start: float, did_assume: bool):
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        self.f.spans.stage(key, "bind", node=dest)
         binding = api.Binding(
             metadata=api.ObjectMeta(name=pod.metadata.name,
                                     namespace=pod.metadata.namespace),
@@ -246,6 +287,7 @@ class Scheduler:
             return
         METRICS.observe("scheduler_e2e_scheduling_latency_seconds",
                         time.perf_counter() - t_start)
+        self.f.spans.finish(key)
         self.recorder.event(pod, "Normal", "Scheduled",
                             f"Successfully assigned {pod.metadata.name} to {dest}")
 
@@ -253,6 +295,8 @@ class Scheduler:
         """Error func: event + condition + backoff requeue
         (scheduler.go:102-107, factory.go:503-539)."""
         log.info("failed to schedule %s: %s", pod.metadata.name, err)
+        self.f.spans.finish(f"{pod.metadata.namespace}/{pod.metadata.name}",
+                            error=str(err))
         self.recorder.event(pod, "Warning", "FailedScheduling", str(err))
         try:
             self.f.client.request(

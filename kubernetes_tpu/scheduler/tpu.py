@@ -43,6 +43,15 @@ Health is exported as the `scheduler_kernel_health` gauge (1 ok / 0.5
 degraded / 0 failed) plus `scheduler_kernel_fallbacks_total{reason=...}`;
 `healthy()` is the hook the scheduler component entrypoint serves as
 /healthz.
+
+Observability (round-5 postmortem): the kernel pipeline runs as named,
+deadlined stages (tensorize -> upload -> compile|solve) through
+ops/watchdog.run_stages — durations land in
+`scheduler_stage_seconds{stage}`, a hang becomes a StageTimeout +
+`scheduler_stage_timeout_total{stage}` tick classified as a transient
+device error (backoff + sequential fallback, never a silent wedge), and
+each batch carries a Span whose stage children and per-pod trace links
+make a stuck drain attributable to the exact stage.
 """
 
 from __future__ import annotations
@@ -55,9 +64,11 @@ from typing import List, Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.ops.kernel import Weights
+from kubernetes_tpu.ops.watchdog import DEFAULT_DEADLINES, run_stages
 from kubernetes_tpu.scheduler.factory import ConfigFactory, Scheduler
 from kubernetes_tpu.scheduler.generic import FitError
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+from kubernetes_tpu.utils.trace import Span
 
 log = logging.getLogger("scheduler.tpu")
 
@@ -107,10 +118,16 @@ class BatchScheduler(Scheduler):
                  degraded_after: int = 3, fail_after: int = 10,
                  retry_initial: float = 1.0, retry_max: float = 60.0,
                  bug_cooldown: float = 300.0, clock=time.monotonic,
-                 incremental: bool = True):
+                 incremental: bool = True,
+                 stage_deadlines: Optional[dict] = None):
         super().__init__(factory, algorithm)
         self.batch_size = batch_size
         self.weights = weights or Weights()
+        # per-stage watchdog deadlines (tensorize/upload/compile/solve): a
+        # hang becomes a StageTimeout + scheduler_stage_timeout_total tick
+        # and takes the device-error fallback path, never a silent wedge
+        self.stage_deadlines = dict(DEFAULT_DEADLINES)
+        self.stage_deadlines.update(stage_deadlines or {})
         # the incremental mirror replaces the per-batch world rebuild
         # (SURVEY §7 hard part #2); it subscribes to cache deltas and keeps
         # node-side tensors device-resident across batches
@@ -217,10 +234,27 @@ class BatchScheduler(Scheduler):
             return 0
         pods = [first] + self.f.pending.drain(self.batch_size - 1)
         t_start = time.perf_counter()
+        # one batch span; per-pod roots close their queue_wait stage here
+        # and carry a link to the batch trace that solves them
+        batch_span = Span("schedule_batch", pods=len(pods))
+        for pod in pods:
+            self._note_popped(pod)
+            self.f.spans.annotate(
+                f"{pod.metadata.namespace}/{pod.metadata.name}",
+                batch_trace=batch_span.trace_id,
+                batch_span=batch_span.span_id)
 
+        try:
+            return self._schedule_batch(pods, t_start, batch_span)
+        finally:
+            batch_span.finish()
+
+    def _schedule_batch(self, pods: List[api.Pod], t_start: float,
+                        batch_span: Span) -> int:
         if not self.kernel_available():
             # disabled (failed-state cooldown) or inside the device-error
             # backoff window: sequential path, no device attempt
+            batch_span.attrs["path"] = "sequential"
             self._fallback_sequential(pods)
             return len(pods)
 
@@ -245,10 +279,14 @@ class BatchScheduler(Scheduler):
                             for p in ni.pods]
         except Exception as e:
             log.warning("cluster snapshot failed (%s); sequential fallback", e)
+            batch_span.attrs["path"] = "sequential"
             self._fallback_sequential(pods)
             return len(pods)
 
         try:
+            # span handed over via attribute: _run_kernel's (nodes, existing,
+            # pending) signature is a seam tests replace wholesale
+            self._batch_span = batch_span
             with METRICS.time("scheduler_scheduling_algorithm_latency_seconds"):
                 results = self._run_kernel(nodes, existing, pods)
             if len(results) != len(pods):
@@ -257,6 +295,7 @@ class BatchScheduler(Scheduler):
                     f"{len(pods)} pods")
         except Exception as e:
             self._on_kernel_failure(e, len(pods))
+            batch_span.attrs["error"] = repr(e)
             if not _is_device_error(e):
                 # a corrupted incremental mirror would reproduce a BUG
                 # forever: rebuild it from the cache before the next attempt
@@ -285,11 +324,22 @@ class BatchScheduler(Scheduler):
 
     def _run_kernel(self, nodes: List[api.Node], existing: List[api.Pod],
                     pending: List[api.Pod]) -> List[Optional[str]]:
+        """The staged, deadlined device pipeline: every stage (tensorize ->
+        upload -> compile|solve) runs under its watchdog deadline and is
+        exported as a scheduler_stage_seconds series + a child span of the
+        batch span."""
+        batch_span = getattr(self, "_batch_span", None)
         if self._inc is not None:
-            return self._inc.schedule(pending, self.weights)
+            inc = self._inc
+            return run_stages(
+                lambda stage: inc.schedule(pending, self.weights, stage=stage),
+                deadlines=self.stage_deadlines, span=batch_span)
         from kubernetes_tpu.scheduler.batch import tpu_batch
-        return tpu_batch(nodes, existing, pending, self.f.plugin_args,
-                         self.weights)
+        return run_stages(
+            lambda stage: tpu_batch(nodes, existing, pending,
+                                    self.f.plugin_args, self.weights,
+                                    stage=stage),
+            deadlines=self.stage_deadlines, span=batch_span)
 
     def resync_incremental(self):
         """Drop and re-mirror the incremental state from the cache — the
@@ -329,7 +379,9 @@ def create_batch_scheduler(factory: ConfigFactory,
                            provider_name: Optional[str] = None,
                            batch_size: int = 4096,
                            weights: Optional[Weights] = None,
-                           strict: bool = False) -> BatchScheduler:
+                           strict: bool = False,
+                           stage_deadlines: Optional[dict] = None
+                           ) -> BatchScheduler:
     """Build a BatchScheduler whose fallback algorithm is the oracle built
     from the same provider (CreateFromProvider seam, factory.go:248-342)."""
     from kubernetes_tpu.scheduler.generic import GenericScheduler
@@ -341,4 +393,5 @@ def create_batch_scheduler(factory: ConfigFactory,
     priorities = get_priorities(prov["priorities"], factory.plugin_args)
     algorithm = GenericScheduler(predicates, priorities)
     return BatchScheduler(factory, algorithm, batch_size=batch_size,
-                          weights=weights, strict=strict)
+                          weights=weights, strict=strict,
+                          stage_deadlines=stage_deadlines)

@@ -1,0 +1,203 @@
+"""Event recording: correlation, aggregation, and spam filtering.
+
+Parity target: reference pkg/client/record — EventRecorder/EventBroadcaster
+(event.go:96,112) plus the full events_cache.go correlation stack:
+
+- **logger dedup** (events_cache.go:69-75): an exact repeat of the same
+  (object, source, type, reason, message) becomes a count bump via PUT
+  instead of a new Event object;
+- **aggregation** (EventAggregator): more than `max_similar` events that
+  differ ONLY in message within `similar_interval` collapse into one
+  "(combined from similar events)" Event whose count keeps climbing — the
+  control that keeps a crash-looping container from minting a distinct
+  Event per iteration;
+- **spam filtering** (EventSourceObjectSpamFilter): a token bucket per
+  (source, object) drops events beyond `spam_burst` with a slow refill,
+  so not even aggregated PUTs can melt the API server during a 5k-node
+  "FailedScheduling" storm. Drops are visible as the
+  `events_discarded_total` counter, emissions as `events_emitted_total`.
+
+Every component (scheduler, kubelet, node/replication controllers) posts
+through one of these recorders; `kubectl get events` / `describe` read the
+result back from the apiserver.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.rest import ApiError, RESTClient
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+from kubernetes_tpu.utils.timeutil import now_iso as _now_iso
+
+log = logging.getLogger("events")
+
+# correlation cache caps (the reference's events_cache LRU analogues)
+MAX_AGGREGATION_ENTRIES = 4096
+
+# aggregation: > this many similar-but-for-message events inside the
+# interval collapse onto one aggregate Event (events_cache.go maxEvents=10)
+DEFAULT_MAX_SIMILAR = 10
+DEFAULT_SIMILAR_INTERVAL = 600.0
+
+# spam filter token bucket per (source, object): burst 25, one token back
+# every 5 minutes (events_cache.go defaultSpamBurst/defaultSpamQPS)
+DEFAULT_SPAM_BURST = 25
+DEFAULT_SPAM_QPS = 1.0 / 300.0
+
+AGGREGATED_PREFIX = "(combined from similar events): "
+
+
+class EventCorrelator:
+    """Decides, for each observed event, whether it should be dropped
+    (spam), aggregated (similar storm), or recorded as-is — and under which
+    dedup identity repeats bump a count instead of minting a new Event."""
+
+    def __init__(self, clock=time.monotonic,
+                 max_similar: int = DEFAULT_MAX_SIMILAR,
+                 similar_interval: float = DEFAULT_SIMILAR_INTERVAL,
+                 spam_burst: int = DEFAULT_SPAM_BURST,
+                 spam_qps: float = DEFAULT_SPAM_QPS,
+                 cache_size: int = MAX_AGGREGATION_ENTRIES):
+        self._clock = clock
+        self._max_similar = max_similar
+        self._similar_interval = similar_interval
+        self._spam_burst = spam_burst
+        self._spam_qps = spam_qps
+        self._cache_size = cache_size
+        self._lock = threading.Lock()
+        # (source, object) -> [tokens, last refill time]
+        self._spam: "OrderedDict[Tuple, list]" = OrderedDict()
+        # similarity key (everything but message) -> [distinct message set,
+        # window start] — the reference aggregator's localKeys: only
+        # DISTINCT messages advance toward aggregation, exact repeats are
+        # the logger-dedup path's job
+        self._similar: "OrderedDict[Tuple, list]" = OrderedDict()
+
+    def _cap(self, cache: OrderedDict):
+        while len(cache) > self._cache_size:
+            cache.popitem(last=False)
+
+    def correlate(self, source_key: Tuple, similarity_key: Tuple,
+                  message: str) -> Optional[Tuple[Tuple, str, bool]]:
+        """Returns (dedup key, message to record, aggregated?) — or None when
+        the spam filter drops the event."""
+        now = self._clock()
+        with self._lock:
+            tokens, last = self._spam.get(source_key, (self._spam_burst, now))
+            tokens = min(self._spam_burst,
+                         tokens + (now - last) * self._spam_qps)
+            if tokens < 1.0:
+                self._spam[source_key] = [tokens, now]
+                self._spam.move_to_end(source_key)
+                return None
+            self._spam[source_key] = [tokens - 1.0, now]
+            self._spam.move_to_end(source_key)
+            self._cap(self._spam)
+
+            rec = self._similar.get(similarity_key)
+            if rec is None or now - rec[1] > self._similar_interval:
+                rec = [set(), now]
+            if len(rec[0]) <= self._max_similar:
+                rec[0].add(message)
+            self._similar[similarity_key] = rec
+            self._similar.move_to_end(similarity_key)
+            self._cap(self._similar)
+            if len(rec[0]) > self._max_similar:
+                # storm of similar events: they all collapse onto ONE
+                # aggregate identity regardless of message
+                return similarity_key, AGGREGATED_PREFIX + message, True
+            return similarity_key + (message,), message, False
+
+
+class EventRecorder:
+    """`event(obj, type, reason, message)` — async fire-and-forget like the
+    reference broadcaster (a blocked event sink must never stall the
+    scheduler loop)."""
+
+    def __init__(self, client: RESTClient, source_component: str,
+                 source_host: str = "",
+                 correlator: Optional[EventCorrelator] = None):
+        self.client = client
+        self.source = api.EventSource(component=source_component,
+                                      host=source_host)
+        self.correlator = correlator or EventCorrelator()
+        # dedup key -> (event name, count); LRU-capped so long-running
+        # components don't grow without bound
+        self._seen: "OrderedDict[Tuple, Tuple[str, int]]" = OrderedDict()
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(target=self._pump,
+                                        name="event-recorder", daemon=True)
+        self._started = False
+        self._lock = threading.Lock()
+
+    def event(self, obj, etype: str, reason: str, message: str):
+        with self._lock:
+            if not self._started:
+                self._thread.start()
+                self._started = True
+        self._q.put((obj, etype, reason, message))
+
+    def flush(self, timeout: float = 5.0):
+        """Best-effort wait for queued events to be posted (tests)."""
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    def _pump(self):
+        while True:
+            obj, etype, reason, message = self._q.get()
+            try:
+                self._record(obj, etype, reason, message)
+            except Exception as e:
+                log.warning("event post failed: %s", e)
+
+    def _record(self, obj, etype: str, reason: str, message: str):
+        meta = obj.metadata
+        ref = api.ObjectReference(
+            kind=type(obj).__name__, namespace=meta.namespace, name=meta.name,
+            uid=meta.uid, resource_version=meta.resource_version)
+        source_key = (self.source.component, self.source.host,
+                      ref.kind, ref.namespace, ref.name, ref.uid)
+        similarity_key = (ref.kind, ref.namespace, ref.name, etype, reason)
+        hit = self.correlator.correlate(source_key, similarity_key, message)
+        if hit is None:
+            METRICS.inc("events_discarded_total",
+                        component=self.source.component)
+            return
+        dedup_key, message, _aggregated = hit
+        METRICS.inc("events_emitted_total", component=self.source.component)
+        ns = meta.namespace or "default"
+        existing = self._seen.get(dedup_key)
+        if existing is not None:
+            name, count = existing
+            try:
+                ev = self.client.get("events", name, ns)
+                ev.count = count + 1
+                ev.last_timestamp = _now_iso()
+                if message != ev.message:
+                    ev.message = message
+                self.client.update("events", ev, ns)
+                self._seen[dedup_key] = (name, count + 1)
+                self._seen.move_to_end(dedup_key)
+                return
+            except ApiError:
+                pass  # fall through to create
+        now = _now_iso()
+        name = f"{meta.name}.{int(time.time() * 1e6):x}"
+        ev = api.Event(
+            metadata=api.ObjectMeta(name=name, namespace=ns),
+            involved_object=ref, reason=reason, message=message,
+            source=self.source, type=etype,
+            first_timestamp=now, last_timestamp=now, count=1)
+        self.client.create("events", ev, ns)
+        self._seen[dedup_key] = (name, 1)
+        self._seen.move_to_end(dedup_key)
+        while len(self._seen) > MAX_AGGREGATION_ENTRIES:
+            self._seen.popitem(last=False)

@@ -106,6 +106,21 @@ class MetricsRegistry:
             h = self._histograms.get(name)
             return sum(h._totals.values()) if h is not None else 0
 
+    def counter_series(self, name: str) -> Dict[Tuple, float]:
+        """Locked snapshot of one counter family: {label tuple: value}."""
+        with self._lock:
+            return dict(self._counters.get(name, {}))
+
+    def hist_stats(self, name: str) -> Dict[Tuple, Tuple[int, float]]:
+        """Locked snapshot of one histogram family:
+        {label tuple: (observation count, sum of values)} — the source the
+        bench's per-stage breakdown renders from."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                return {}
+            return {lk: (h._totals[lk], h._sums[lk]) for lk in h._totals}
+
     def hist_snapshot(self, name: str):
         """Locked copy of a histogram's (counts, totals) — the 'before' side
         of delta_quantile (SLO windows scoped to one phase, the way the

@@ -8,7 +8,94 @@ CPU-only entrypoint (tests/conftest.py, bench.py's fallback, direct drives)
 shares this dance here instead of hand-copying it.
 """
 
+import hashlib
 import os
+import re
+
+# live persistent-cache state (set by enable_persistent_compilation_cache);
+# the compile-cache hit/miss/rejection accounting reads it
+_CACHE_STATE = {"dir": "", "fingerprint": ""}
+
+_MACHINE_MARKER = "MACHINE_FEATURES"
+
+
+def _label_safe(s: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", s) or "unknown"
+
+
+def machine_fingerprint(include_device: bool = False) -> str:
+    """Device + host machine-feature fingerprint keying AOT compile-cache
+    entries (the round-5 failure mode: an artifact compiled for different
+    machine features loaded and wedged the CPU fallback for 600 s).
+
+    Host features only by default — computing the fingerprint must never
+    initialize a jax backend (backend init is itself a hang risk). Pass
+    include_device=True only when a backend is known-live (e.g. right after
+    a successful dispatch) to refine the label with the device kind.
+    """
+    import platform as _p
+
+    feats = [_p.machine(), _p.system(),
+             os.environ.get("JAX_PLATFORMS", ""),
+             "axon" if os.environ.get("PALLAS_AXON_POOL_IPS") else "host"]
+    device = ""
+    try:
+        import jax
+        feats.append(jax.__version__)
+        if include_device:
+            d = jax.devices()[0]
+            device = getattr(d, "device_kind", "") or d.platform
+            feats.append(device)
+    except Exception:
+        pass
+    tag = _label_safe("-".join(
+        t for t in (_p.machine(),
+                    os.environ.get("JAX_PLATFORMS") or "auto", device) if t))
+    return f"{tag}-{hashlib.sha1('|'.join(feats).encode()).hexdigest()[:8]}"
+
+
+def compile_cache_dir() -> str:
+    return _CACHE_STATE["dir"]
+
+
+def compile_cache_snapshot():
+    """Entry listing of the live persistent cache dir (None when disabled) —
+    the 'before' side of record_compile_cache_event."""
+    d = _CACHE_STATE["dir"]
+    if not d:
+        return None
+    try:
+        return frozenset(os.listdir(d))
+    except OSError:
+        return None
+
+
+def record_compile_cache_event(before, registry=None) -> str:
+    """Classify the compile that just ran against the persistent cache and
+    tick `compile_cache_events_total{event,fingerprint}`. A dispatch that
+    persisted a new entry is a miss; one that wrote nothing against a
+    NON-EMPTY cache was (almost certainly — a sub-threshold compile is
+    indistinguishable) served from it: hit; nothing written against an
+    EMPTY cache cannot be a hit and is "uncached" (compile below the
+    persistence threshold); no cache dir means disabled. Returns the
+    event label."""
+    if registry is None:
+        from kubernetes_tpu.utils.metrics import REGISTRY as registry
+    after = compile_cache_snapshot()
+    if before is None or after is None:
+        event = "disabled"
+    elif after - before:
+        event = "miss"
+    elif any(e != _MACHINE_MARKER for e in before):
+        event = "hit"
+    else:
+        event = "uncached"
+    # label with the fingerprint that KEYS the live cache (the marker file /
+    # directory name), so hit/miss/rejected series for one cache identity
+    # join on one label value
+    fp = _CACHE_STATE["fingerprint"] or machine_fingerprint()
+    registry.inc("compile_cache_events_total", event=event, fingerprint=fp)
+    return event
 
 
 def clear_backends_compat():
@@ -26,18 +113,61 @@ def enable_persistent_compilation_cache(path: str = "") -> str:
     binding must be seconds, not the compile time).
 
     The cache key includes program HLO + compile options + backend, so a
-    kernel/feature/shape change misses cleanly. Returns the directory."""
+    kernel/feature/shape change misses cleanly. Entries are additionally
+    keyed by the HOST machine-feature fingerprint: each fingerprint gets its
+    own subdirectory, so an AOT artifact compiled on different machine
+    features can never be loaded here (the round-5 0.0-pods/s failure), and
+    a marker file validates the directory on every enable — a mismatch is
+    counted as `compile_cache_events_total{event="rejected"}` and the stale
+    entries are dropped. Returns the directory."""
+    import shutil
+
     import jax
 
-    cache_dir = (path or os.environ.get("KTPU_XLA_CACHE")
-                 or os.path.join(os.path.expanduser("~"), ".cache",
-                                 "kubernetes-tpu-xla"))
+    from kubernetes_tpu.utils.metrics import REGISTRY as _METRICS
+
+    root = (path or os.environ.get("KTPU_XLA_CACHE")
+            or os.path.join(os.path.expanduser("~"), ".cache",
+                            "kubernetes-tpu-xla"))
+    fp = machine_fingerprint()
+    os.makedirs(root, exist_ok=True)
+    # pre-fingerprint layouts put artifacts directly in the root; they can't
+    # be validated against machine features, so they are rejected — never
+    # loaded (jax is pointed at the fingerprint subdir) and left in place:
+    # the root may be a user-chosen shared directory (KTPU_XLA_CACHE), and
+    # deleting files there that we didn't write would be data loss
+    legacy = [e for e in os.listdir(root)
+              if not os.path.isdir(os.path.join(root, e))]
+    if legacy:
+        _METRICS.inc("compile_cache_events_total",
+                     event="rejected", fingerprint=fp)
+        import logging
+        logging.getLogger("platform").warning(
+            "compile cache root %s holds %d unvalidated pre-fingerprint "
+            "entries; ignoring them (rejected)", root, len(legacy))
+    cache_dir = os.path.join(root, fp)
     os.makedirs(cache_dir, exist_ok=True)
+    marker = os.path.join(cache_dir, _MACHINE_MARKER)
+    stamped = ""
+    if os.path.exists(marker):
+        with open(marker) as f:
+            stamped = f.read().strip()
+    if stamped and stamped != fp:
+        # a foreign-machine cache under our fingerprint path (copied dirs,
+        # changed env): reject and start clean rather than load it
+        _METRICS.inc("compile_cache_events_total", event="rejected",
+                     fingerprint=fp)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        os.makedirs(cache_dir, exist_ok=True)
+    with open(marker, "w") as f:
+        f.write(fp + "\n")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_enable_compilation_cache", True)
     # the scan kernel is the whole point: cache anything non-trivial
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _CACHE_STATE["dir"] = cache_dir
+    _CACHE_STATE["fingerprint"] = fp
     return cache_dir
 
 

@@ -1,22 +1,234 @@
-"""Step tracing: named multi-step traces logged only when over threshold.
+"""Spans + step tracing for the scheduling pipeline.
 
-Parity target: reference pkg/util/trace.go:32-67 — the scheduler wraps every
-Schedule() in a trace with steps "Computing predicates"/"Prioritizing"/
-"Selecting host" and logs it only if the decision exceeded 20ms
-(generic_scheduler.go:71-77).
+Two layers:
+
+- `Trace` — the original threshold-logged step trace (reference
+  pkg/util/trace.go:32-67; the sequential scheduler wraps Schedule() in one
+  and logs it only past 20ms).
+- `Span` / `SpanTracker` — correlated spans with trace/span IDs and parent
+  links, carried from pod arrival (informer delivery) through queue wait,
+  the kernel pipeline stages (tensorize / upload / solve), and bind.  A
+  span's `finish(metric=...)` exports its duration straight into the
+  metrics registry, so the span structure and the SLI histograms
+  (`scheduler_pod_queue_wait_seconds`, `scheduler_stage_seconds`, ...) are
+  one measurement, not two.  Finished spans land in a bounded ring
+  (`recent_spans`) for tests and postmortems — the compact stand-in for a
+  span exporter.
 """
 
 from __future__ import annotations
 
+import itertools
 import logging
+import os
+import threading
 import time
+from collections import OrderedDict, deque
 from contextlib import contextmanager
-from typing import List, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 log = logging.getLogger("trace")
 
+_ID_PREFIX = os.urandom(4).hex()  # per-process uniqueness
+_ID_COUNTER = itertools.count(1)
+
+
+def new_id() -> str:
+    return f"{_ID_PREFIX}-{next(_ID_COUNTER):x}"
+
+
+class Span:
+    """One timed operation. Children share the trace_id and point at their
+    parent via parent_id; `finish` stamps the end and (optionally) records
+    the duration into a registry histogram."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
+                 "attrs", "children")
+
+    def __init__(self, name: str, trace_id: Optional[str] = None,
+                 parent: Optional["Span"] = None, **attrs):
+        self.name = name
+        self.trace_id = trace_id or (parent.trace_id if parent else new_id())
+        self.span_id = new_id()
+        self.parent_id = parent.span_id if parent else ""
+        self.start = time.perf_counter()
+        self.end: Optional[float] = None
+        self.attrs: Dict[str, object] = dict(attrs)
+        self.children: List[Span] = []
+        if parent is not None:
+            parent.children.append(self)
+
+    def child(self, name: str, **attrs) -> "Span":
+        return Span(name, parent=self, **attrs)
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None
+                else time.perf_counter()) - self.start
+
+    def finish(self, metric: Optional[str] = None, registry=None,
+               **labels) -> float:
+        if self.end is None:
+            self.end = time.perf_counter()
+            _record_span(self)
+        d = self.end - self.start
+        if metric:
+            (registry or METRICS).observe(metric, d, **labels)
+        return d
+
+    @contextmanager
+    def timed(self, name: str, metric: Optional[str] = None, **labels):
+        c = self.child(name)
+        try:
+            yield c
+        finally:
+            c.finish(metric=metric, **labels)
+
+    def tree_lines(self, indent: str = "") -> List[str]:
+        lines = [f"{indent}{self.name} [{self.span_id}"
+                 f"{' <- ' + self.parent_id if self.parent_id else ''}]"
+                 f" {self.duration * 1000:.1f}ms {self.attrs or ''}"]
+        for c in self.children:
+            lines.extend(c.tree_lines(indent + "  "))
+        return lines
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, trace={self.trace_id},"
+                f" id={self.span_id}, parent={self.parent_id or None})")
+
+
+# bounded exporter ring: tests and postmortems read finished spans here
+_RECENT: "deque[Span]" = deque(maxlen=4096)
+_RECENT_LOCK = threading.Lock()
+
+
+def _record_span(span: Span):
+    with _RECENT_LOCK:
+        _RECENT.append(span)
+
+
+def recent_spans(name: Optional[str] = None,
+                 trace_id: Optional[str] = None) -> List[Span]:
+    with _RECENT_LOCK:
+        out = list(_RECENT)
+    if name is not None:
+        out = [s for s in out if s.name == name]
+    if trace_id is not None:
+        out = [s for s in out if s.trace_id == trace_id]
+    return out
+
+
+def clear_recent():
+    with _RECENT_LOCK:
+        _RECENT.clear()
+
+
+class SpanTracker:
+    """Bounded key -> live-root-span map: the correlation table the
+    scheduler uses to carry one span per pending pod across threads
+    (informer dispatch -> batch loop -> bind pool). At most one open child
+    ("stage") per key."""
+
+    def __init__(self, cap: int = 65536, slow_log_seconds: float = 0.0):
+        self._cap = cap
+        self._slow = slow_log_seconds
+        self._lock = threading.Lock()
+        # key -> (root span, open stage child or None)
+        self._live: "OrderedDict[str, list]" = OrderedDict()
+
+    def start(self, key: str, name: str, **attrs) -> Span:
+        sp = Span(name, **attrs)
+        with self._lock:
+            self._live[key] = [sp, None]
+            self._live.move_to_end(key)
+            while len(self._live) > self._cap:
+                self._live.popitem(last=False)
+        return sp
+
+    def current(self, key: str) -> Optional[Span]:
+        with self._lock:
+            rec = self._live.get(key)
+            return rec[0] if rec else None
+
+    def annotate(self, key: str, **attrs):
+        with self._lock:
+            rec = self._live.get(key)
+            if rec:
+                rec[0].attrs.update(attrs)
+
+    def stage(self, key: str, name: str, **attrs) -> Optional[Span]:
+        """Open a named child of the key's root, closing any open stage;
+        idempotent when the open stage already has this name."""
+        with self._lock:
+            rec = self._live.get(key)
+            if rec is None:
+                return None
+            root, open_stage = rec
+            if open_stage is not None:
+                if open_stage.name == name:
+                    return open_stage
+                open_stage.finish()
+            child = root.child(name, **attrs)
+            rec[1] = child
+            return child
+
+    def stage_if_idle(self, key: str, name: str, **attrs) -> Optional[Span]:
+        """Open a named child only when no OTHER stage is open — a pod
+        mid-bind must not have its live stage clobbered by a watch-echo
+        re-enqueue."""
+        with self._lock:
+            rec = self._live.get(key)
+            if rec is None:
+                return None
+            root, open_stage = rec
+            if open_stage is not None:
+                return open_stage if open_stage.name == name else None
+            child = root.child(name, **attrs)
+            rec[1] = child
+            return child
+
+    def end_stage(self, key: str, metric: Optional[str] = None,
+                  name: Optional[str] = None, **labels) -> Optional[Span]:
+        """Close the open stage; with `name` given, only if it matches —
+        the metric must never be fed some other stage's duration."""
+        with self._lock:
+            rec = self._live.get(key)
+            if rec is None or rec[1] is None:
+                return None
+            child = rec[1]
+            if name is not None and child.name != name:
+                return None
+            rec[1] = None
+        child.finish(metric=metric, **labels)
+        return child
+
+    def finish(self, key: str, metric: Optional[str] = None,
+               error: Optional[str] = None, **labels) -> Optional[Span]:
+        with self._lock:
+            rec = self._live.pop(key, None)
+        if rec is None:
+            return None
+        root, open_stage = rec
+        if open_stage is not None:
+            open_stage.finish()
+        if error is not None:
+            root.attrs["error"] = error
+        root.finish(metric=metric, **labels)
+        if self._slow and root.duration >= self._slow:
+            log.info("slow span %s:\n%s", key, "\n".join(root.tree_lines()))
+        return root
+
+    def discard(self, key: str):
+        with self._lock:
+            self._live.pop(key, None)
+
 
 class Trace:
+    """Named multi-step trace logged only when over threshold
+    (generic_scheduler.go:71-77 semantics)."""
+
     def __init__(self, name: str, **fields):
         self.name = name
         self.fields = fields

@@ -23,15 +23,29 @@ from kubernetes_tpu.utils.flowcontrol import Backoff
 class WorkQueue:
     """Deduplicating FIFO of hashable items with in-flight tracking:
     `add` while an item is processing marks it dirty for reprocessing after
-    `done` (reference workqueue.Type semantics)."""
+    `done` (reference workqueue.Type semantics).
 
-    def __init__(self):
+    A named queue exports the reference's workqueue SLIs
+    (prometheus adapter of workqueue.go): `workqueue_depth{queue}`,
+    `workqueue_queue_latency_seconds{queue}` (add -> get) and
+    `workqueue_work_duration_seconds{queue}` (get -> done)."""
+
+    def __init__(self, name: str = ""):
         self._cond = threading.Condition()
         self._queue: list = []
         self._queued: set = set()
         self._processing: set = set()
         self._dirty: set = set()
         self._shutdown = False
+        self.name = name
+        self._added_at: dict = {}
+        self._started_at: dict = {}
+
+    def _set_depth(self):
+        if self.name:
+            from kubernetes_tpu.utils.metrics import REGISTRY
+            REGISTRY.set_gauge("workqueue_depth", len(self._queue),
+                               queue=self.name)
 
     def add(self, item):
         with self._cond:
@@ -42,6 +56,9 @@ class WorkQueue:
                 return
             self._queued.add(item)
             self._queue.append(item)
+            if self.name:
+                self._added_at.setdefault(item, time.monotonic())
+                self._set_depth()
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None):
@@ -56,15 +73,34 @@ class WorkQueue:
             item = self._queue.pop(0)
             self._queued.discard(item)
             self._processing.add(item)
+            if self.name:
+                from kubernetes_tpu.utils.metrics import REGISTRY
+                now = time.monotonic()
+                added = self._added_at.pop(item, None)
+                if added is not None:
+                    REGISTRY.observe("workqueue_queue_latency_seconds",
+                                     now - added, queue=self.name)
+                self._started_at[item] = now
+                self._set_depth()
             return item
 
     def done(self, item):
         with self._cond:
             self._processing.discard(item)
+            if self.name:
+                started = self._started_at.pop(item, None)
+                if started is not None:
+                    from kubernetes_tpu.utils.metrics import REGISTRY
+                    REGISTRY.observe("workqueue_work_duration_seconds",
+                                     time.monotonic() - started,
+                                     queue=self.name)
             if item in self._dirty:
                 self._dirty.discard(item)
                 self._queued.add(item)
                 self._queue.append(item)
+                if self.name:
+                    self._added_at.setdefault(item, time.monotonic())
+                    self._set_depth()
                 self._cond.notify()
 
     def shutdown(self):
@@ -81,8 +117,8 @@ class DelayingQueue(WorkQueue):
     """add_after(item, delay): deliver after delay via a waiting thread and
     a heap (reference delaying_queue.go)."""
 
-    def __init__(self, clock=time.monotonic):
-        super().__init__()
+    def __init__(self, clock=time.monotonic, name: str = ""):
+        super().__init__(name=name)
         self._clock = clock
         self._heap: list = []
         self._heap_cond = threading.Condition()
@@ -123,8 +159,8 @@ class RateLimitingQueue(DelayingQueue):
     ItemExponentialFailureRateLimiter)."""
 
     def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0,
-                 clock=time.monotonic):
-        super().__init__(clock=clock)
+                 clock=time.monotonic, name: str = ""):
+        super().__init__(clock=clock, name=name)
         self._backoff = Backoff(initial=base_delay, maximum=max_delay, clock=clock)
 
     def add_rate_limited(self, item):

@@ -1,0 +1,569 @@
+"""End-to-end scheduling observability (ISSUE 8).
+
+- Events: recorder correlation (exact-dedup count bumps, similar-storm
+  aggregation) and spam-filter semantics, posted through the live apiserver.
+- Pipeline spans: IDs + parent links carried from pod arrival through queue
+  wait, the kernel stages (tensorize/upload/compile|solve), and bind.
+- Stage watchdogs: an injected kernel-stage hang surfaces as a
+  scheduler_stage_timeout metric + structured StageTimeout within the stage
+  deadline, and the batch falls back sequentially instead of wedging.
+- SLI exposition: e2e scheduling latency, pod startup latency, informer
+  watch lag, and workqueue depth/latency all served on /metrics.
+- Round-5 hardening satellites: federation probe loop, route-controller
+  CIDR reclaim, volume-manager lock scope, TLS verification opt-in.
+"""
+
+import io
+import os
+import threading
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.client.rest import ApiError
+from kubernetes_tpu.utils import trace
+from kubernetes_tpu.utils.events import EventCorrelator, EventRecorder
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+
+def wait_for(cond, timeout=30.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, qps=5000, burst=5000)
+
+
+def mk_pod(name, ns="default"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="pause",
+            resources=api.ResourceRequirements(
+                requests={"cpu": "100m", "memory": "100Mi"}))]))
+
+
+def mk_node(name):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name,
+                                labels={api.LABEL_HOSTNAME: name}),
+        status=api.NodeStatus(
+            allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+
+# --- events: correlation / aggregation / spam filter -------------------------
+
+class TestEventCorrelation:
+    def test_exact_repeat_bumps_count(self, client):
+        rec = EventRecorder(client, "test-comp")
+        pod = client.create("pods", mk_pod("dup"))
+        for _ in range(3):
+            rec.event(pod, "Warning", "FailedScheduling", "no nodes")
+        rec.flush()
+        wait_for(lambda: client.list("events", "default")[0]
+                 and client.list("events", "default")[0][0].count == 3,
+                 msg="count bump")
+        evs, _ = client.list("events", "default")
+        assert len(evs) == 1
+        assert evs[0].reason == "FailedScheduling"
+
+    def test_similar_storm_aggregates(self, client):
+        """> max_similar events differing only in message collapse onto one
+        '(combined from similar events)' aggregate whose count climbs."""
+        rec = EventRecorder(
+            client, "test-comp",
+            correlator=EventCorrelator(max_similar=3))
+        pod = client.create("pods", mk_pod("stormy"))
+        for i in range(8):
+            rec.event(pod, "Warning", "Unhealthy", f"probe failed #{i}")
+        rec.flush()
+        wait_for(lambda: any(
+            e.message.startswith("(combined from similar events)")
+            for e in client.list("events", "default")[0]),
+            msg="aggregate event")
+        evs, _ = client.list("events", "default")
+        # 3 distinct events + 1 aggregate that soaked up the remaining 5
+        assert len(evs) <= 4
+        agg = [e for e in evs
+               if e.message.startswith("(combined from similar events)")]
+        assert len(agg) == 1
+        wait_for(lambda: client.get(
+            "events", agg[0].metadata.name, "default").count >= 5,
+            msg="aggregate count climbs")
+
+    def test_spam_filter_drops(self, client):
+        """Beyond the per-(source, object) burst, events are dropped and
+        counted — not posted."""
+        rec = EventRecorder(
+            client, "spammy",
+            correlator=EventCorrelator(spam_burst=2, spam_qps=0.0))
+        pod = client.create("pods", mk_pod("victim"))
+        before = METRICS.counter_value("events_discarded_total",
+                                       component="spammy")
+        for i in range(10):
+            rec.event(pod, "Warning", "Boom", f"m{i}")
+        rec.flush()
+        wait_for(lambda: METRICS.counter_value(
+            "events_discarded_total", component="spammy") - before == 8,
+            msg="spam drops counted")
+        evs, _ = client.list("events", "default")
+        assert len(evs) == 2
+
+    def test_correlator_unit_semantics(self):
+        c = EventCorrelator(max_similar=2, spam_burst=100)
+        src = ("comp", "", "Pod", "ns", "p", "")
+        sim = ("Pod", "ns", "p", "Warning", "Fail")
+        k1, m1, agg1 = c.correlate(src, sim, "a")
+        k2, m2, agg2 = c.correlate(src, sim, "a")
+        assert k1 == k2 and not agg1 and not agg2  # exact dedup identity
+        k3, _, agg3 = c.correlate(src, sim, "b")
+        assert k3 != k1 and not agg3               # distinct message
+        k4, m4, agg4 = c.correlate(src, sim, "c")
+        assert agg4 and k4 == sim                  # storm -> aggregate
+        assert m4.startswith("(combined from similar events)")
+
+
+# --- pipeline spans + SLIs through a live control plane ----------------------
+
+class TestPipelineObservability:
+    @pytest.fixture()
+    def cluster(self, server, client):
+        from kubernetes_tpu.kubelet.kubelet import Kubelet
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+        trace.clear_recent()
+        client.create("nodes", mk_node("n1"))
+        kubelet = Kubelet(RESTClient.for_server(server), "n1",
+                          sync_period=0.2, heartbeat_period=1.0)
+        kubelet.start(register=False)
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(batch_size=64).run()
+        yield client, factory, sched
+        sched.stop()
+        factory.stop()
+        kubelet.stop()
+
+    def test_spans_and_slis_end_to_end(self, server, cluster):
+        client, factory, sched = cluster
+        client.create("pods", mk_pod("traced"))
+        wait_for(lambda: (client.get("pods", "traced", "default").spec
+                          .node_name), msg="pod bound")
+        wait_for(lambda: (client.get("pods", "traced", "default").status
+                          and client.get("pods", "traced",
+                                         "default").status.phase == "Running"),
+                 msg="pod running")
+
+        # -- span propagation: pod root -> queue_wait + bind children ------
+        root = wait_for(
+            lambda: next((s for s in trace.recent_spans("schedule_pod")
+                          if s.attrs.get("pod") == "default/traced"), None),
+            msg="pod root span")
+        names = {c.name for c in root.children}
+        assert "queue_wait" in names and "bind" in names
+        for c in root.children:
+            assert c.parent_id == root.span_id
+            assert c.trace_id == root.trace_id
+            assert c.end is not None
+        # the batch that solved it links back via the batch trace id
+        batch_trace = root.attrs.get("batch_trace")
+        assert batch_trace
+        batch_roots = trace.recent_spans("schedule_batch",
+                                         trace_id=batch_trace)
+        assert batch_roots
+        stage_names = {c.name for c in batch_roots[0].children}
+        assert "tensorize" in stage_names and "upload" in stage_names
+        assert stage_names & {"compile", "solve"}
+
+        # -- SLI histograms non-empty on the registry ----------------------
+        assert METRICS.hist_total(
+            "scheduler_e2e_scheduling_latency_seconds") >= 1
+        assert METRICS.hist_total("scheduler_pod_queue_wait_seconds") >= 1
+        assert METRICS.hist_total("scheduler_informer_delivery_seconds") >= 1
+        wait_for(lambda: METRICS.hist_total(
+            "kubelet_pod_startup_latency_seconds") >= 1,
+            msg="pod startup latency observed")
+        assert METRICS.hist_total("scheduler_stage_seconds") >= 3
+
+        # -- /metrics exposition (the per-component debug mux) -------------
+        from kubernetes_tpu.utils.debugserver import DebugServer
+        import http.client as hc
+        dbg = DebugServer(port=0).start()
+        try:
+            conn = hc.HTTPConnection("127.0.0.1", dbg.port, timeout=5)
+            conn.request("GET", "/metrics")
+            body = conn.getresponse().read().decode()
+            conn.close()
+        finally:
+            dbg.stop()
+        for series in ("scheduler_e2e_scheduling_latency_seconds_bucket",
+                       "kubelet_pod_startup_latency_seconds_bucket",
+                       "scheduler_pod_queue_wait_seconds_bucket",
+                       "scheduler_stage_seconds_bucket",
+                       "informer_watch_lag_seconds"):
+            assert series in body, f"{series} missing from /metrics"
+
+        # -- events visible through kubectl --------------------------------
+        from kubernetes_tpu.kubectl import cmd as kubectl
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = kubectl.main(["-s", f"127.0.0.1:{server.port}",
+                               "get", "events"])
+        assert rc == 0
+        wait_for(lambda: "Scheduled" in _kubectl_out(server, "get", "events"),
+                 msg="Scheduled event via kubectl")
+        desc = _kubectl_out(server, "describe", "pod", "traced")
+        assert "Events:" in desc and "Scheduled" in desc
+
+    def test_workqueue_slis(self, server, client):
+        """A named controller workqueue exports depth + latency series."""
+        from kubernetes_tpu.controllers.replication_controller import (
+            ReplicationManager,
+        )
+        mgr = ReplicationManager(client, workers=1)
+        mgr.start()
+        try:
+            rc = api.ReplicationController(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ReplicationControllerSpec(
+                    replicas=2, selector={"app": "web"},
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"app": "web"}),
+                        spec=api.PodSpec(containers=[
+                            api.Container(name="c", image="pause")]))))
+            client.create("replicationcontrollers", rc)
+            wait_for(lambda: len(client.list("pods", "default")[0]) == 2,
+                     msg="RC created pods")
+            wait_for(lambda: METRICS.hist_total(
+                "workqueue_queue_latency_seconds") >= 1,
+                msg="workqueue latency observed")
+            wait_for(lambda: METRICS.hist_total(
+                "workqueue_work_duration_seconds") >= 1,
+                msg="workqueue work duration observed")
+            assert "replication" in {
+                dict(lk).get("queue") for lk in METRICS.hist_stats(
+                    "workqueue_queue_latency_seconds")}
+            # the controller's creations surfaced as events on the RC
+            wait_for(lambda: any(
+                e.reason == "SuccessfulCreate"
+                for e in client.list("events", "default")[0]),
+                msg="SuccessfulCreate event")
+        finally:
+            mgr.stop()
+
+
+def _kubectl_out(server, *argv) -> str:
+    from kubernetes_tpu.kubectl import cmd as kubectl
+    out = io.StringIO()
+    with redirect_stdout(out):
+        kubectl.main(["-s", f"127.0.0.1:{server.port}", *argv])
+    return out.getvalue()
+
+
+# --- stage watchdogs ---------------------------------------------------------
+
+class TestStageWatchdog:
+    def test_hang_converts_to_stage_timeout(self):
+        from kubernetes_tpu.ops.watchdog import StageTimeout, run_stages
+        before = METRICS.counter_value("scheduler_stage_timeout_total",
+                                       stage="upload")
+        t0 = time.monotonic()
+        with pytest.raises(StageTimeout) as ei:
+            run_stages(lambda stage: stage("upload",
+                                           lambda: time.sleep(30)),
+                       deadlines={"upload": 0.3})
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0  # structured error within the deadline, no wedge
+        assert ei.value.stage == "upload"
+        assert "upload" in str(ei.value) and "deadline" in str(ei.value)
+        assert METRICS.counter_value("scheduler_stage_timeout_total",
+                                     stage="upload") == before + 1
+
+    def test_stage_timeout_is_transient_for_classifier(self):
+        from kubernetes_tpu.ops.watchdog import StageTimeout
+        from kubernetes_tpu.scheduler.tpu import _is_device_error
+        assert _is_device_error(StageTimeout("solve", 1.0))
+
+    def test_abandoned_stage_leaves_mirror_lock_free(self):
+        """A timed-out (abandoned) device stage must not strand the
+        incremental mirror's lock: cache listeners block on it under the
+        SchedulerCache lock, so a stranded lock would deadlock the whole
+        informer pipeline — worse than the hang being converted."""
+        from kubernetes_tpu.ops import watchdog
+        from kubernetes_tpu.ops.incremental import IncrementalTensorizer
+        inc = IncrementalTensorizer()
+        inc._upload_staged = lambda plan, device=None: time.sleep(60)
+        with pytest.raises(watchdog.StageTimeout):
+            watchdog.run_stages(lambda stage: inc.schedule([], stage=stage),
+                                deadlines={"upload": 0.3})
+        assert inc._lock.acquire(timeout=2.0), \
+            "mirror lock stranded by the abandoned upload worker"
+        inc._lock.release()
+
+    def test_injected_kernel_hang_falls_back(self, server, client):
+        """A hang inside a kernel stage must not wedge the batch: the
+        watchdog converts it to a StageTimeout, the timeout metric ticks,
+        and the drained batch completes via the sequential fallback."""
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+        client.create("nodes", mk_node("n1"))
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(
+            batch_size=16, stage_deadlines={"tensorize": 0.3})
+        before = METRICS.counter_value("scheduler_stage_timeout_total",
+                                       stage="tensorize")
+
+        def hang_schedule(pending, weights=None, device=None, stage=None):
+            return stage("tensorize", lambda: time.sleep(60))
+        sched._inc.schedule = hang_schedule
+        try:
+            client.create("pods", mk_pod("survivor"))
+            wait_for(lambda: len(factory.pending) >= 1, msg="pod queued")
+            t0 = time.monotonic()
+            n = sched.schedule_batch_once(timeout=5)
+            assert n == 1
+            assert time.monotonic() - t0 < 5.0
+            assert METRICS.counter_value(
+                "scheduler_stage_timeout_total",
+                stage="tensorize") == before + 1
+            assert sched.kernel_failures == 1
+            # fell back sequentially: the pod still lands
+            wait_for(lambda: client.get("pods", "survivor",
+                                        "default").spec.node_name == "n1",
+                     msg="fallback bound the pod")
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+# --- compile-cache fingerprinting --------------------------------------------
+
+class TestCompileCacheVisibility:
+    def test_fingerprinted_dir_and_hit_miss_events(self, tmp_path):
+        import jax
+
+        from kubernetes_tpu.utils import platform as plat
+        root = str(tmp_path / "xla")
+        os.makedirs(root)
+        # a legacy (pre-fingerprint) artifact in the root is rejected
+        with open(os.path.join(root, "stale-aot-entry"), "w") as f:
+            f.write("x")
+        saved_dir = dict(plat._CACHE_STATE)
+        try:
+            d = plat.enable_persistent_compilation_cache(root)
+            fp = plat.machine_fingerprint()
+            assert os.path.basename(d) == fp
+            assert os.path.exists(os.path.join(d, "MACHINE_FEATURES"))
+            rejected = METRICS.counter_series("compile_cache_events_total")
+            assert any(dict(lk).get("event") == "rejected" and v >= 1
+                       for lk, v in rejected.items())
+
+            # empty cache (marker only): nothing to hit -> "uncached"
+            before = plat.compile_cache_snapshot()
+            assert plat.record_compile_cache_event(before) == "uncached"
+            # unchanged NON-EMPTY dir between snapshot and record -> hit
+            with open(os.path.join(d, "seeded-entry"), "w") as f:
+                f.write("x")
+            before = plat.compile_cache_snapshot()
+            assert plat.record_compile_cache_event(before) == "hit"
+            # a new entry appeared -> miss
+            before = plat.compile_cache_snapshot()
+            with open(os.path.join(d, "new-entry"), "w") as f:
+                f.write("x")
+            assert plat.record_compile_cache_event(before) == "miss"
+            series = METRICS.counter_series("compile_cache_events_total")
+            labels = [dict(lk) for lk in series]
+            assert all("fingerprint" in d2 for d2 in labels)
+            assert {"hit", "miss"} <= {d2["event"] for d2 in labels}
+        finally:
+            plat._CACHE_STATE.update(saved_dir)
+            jax.config.update("jax_compilation_cache_dir", None)
+
+    def test_disabled_cache_is_visible(self):
+        from kubernetes_tpu.utils import platform as plat
+        saved = dict(plat._CACHE_STATE)
+        plat._CACHE_STATE.update({"dir": "", "fingerprint": ""})
+        try:
+            assert plat.record_compile_cache_event(None) == "disabled"
+        finally:
+            plat._CACHE_STATE.update(saved)
+
+
+# --- round-5 hardening satellites --------------------------------------------
+
+class TestHardeningSatellites:
+    def test_federation_probe_not_self_sustaining(self):
+        """Status-only cluster updates (our own probe writes) must NOT
+        re-enqueue; spec changes must."""
+        from kubernetes_tpu.apis import federation as fedapi
+        from kubernetes_tpu.federation.controller import (
+            ClusterHealthController,
+        )
+        ctl = ClusterHealthController(RESTClient(), probe_period=5.0)
+        try:
+            def cluster(addr, ready):
+                return fedapi.Cluster(
+                    metadata=api.ObjectMeta(name="c1"),
+                    spec=fedapi.ClusterSpec(server_address=addr),
+                    status=fedapi.ClusterStatus(conditions=[
+                        fedapi.ClusterCondition(
+                            type=fedapi.CLUSTER_READY,
+                            status="True" if ready else "False")]))
+            # status flip only: no enqueue (the old self-sustaining loop)
+            ctl._cluster_changed(cluster("127.0.0.1:1", True),
+                                 cluster("127.0.0.1:1", False))
+            assert len(ctl.queue) == 0
+            # spec change: enqueue
+            ctl._cluster_changed(cluster("127.0.0.1:1", True),
+                                 cluster("127.0.0.1:2", True))
+            wait_for(lambda: len(ctl.queue) == 1, timeout=2,
+                     msg="spec change enqueued")
+        finally:
+            ctl.queue.shutdown()
+
+    def test_route_controller_reclaims_cidr_on_patch_failure(self):
+        from kubernetes_tpu.controllers.route_controller import (
+            RouteController,
+        )
+
+        class FailingClient:
+            def __init__(self):
+                self.fail_code = 422
+
+            def patch(self, *a, **kw):
+                if self.fail_code:
+                    raise ApiError(self.fail_code, "Boom", "injected")
+
+        class FakeCloud:
+            def __init__(self):
+                self.routes = {}
+
+            def list_routes(self):
+                return dict(self.routes)
+
+            def create_route(self, name, cidr):
+                self.routes[name] = cidr
+
+            def delete_route(self, name):
+                self.routes.pop(name, None)
+
+        fc = FailingClient()
+        ctl = RouteController.__new__(RouteController)
+        ctl.client = fc
+        ctl.cloud = FakeCloud()
+        import ipaddress
+        ctl.net = ipaddress.ip_network("10.244.0.0/16")
+        ctl.node_mask = 24
+        ctl._cidr_lock = threading.Lock()
+        ctl._issued = {}
+
+        class Store:
+            def __init__(self):
+                self.nodes = {}
+
+            def get(self, key):
+                return self.nodes.get(key)
+
+            def list(self):
+                return list(self.nodes.values())
+
+        class Inf:
+            store = Store()
+        ctl.node_informer = Inf()
+        Inf.store.nodes["n1"] = api.Node(
+            metadata=api.ObjectMeta(name="n1"), spec=api.NodeSpec())
+
+        with pytest.raises(ApiError):
+            ctl.sync("n1")
+        assert ctl._issued == {}, \
+            "definite 4xx rejection must reclaim the CIDR"
+        # ambiguous failure (5xx: the write may have landed server-side)
+        # keeps the guard entry, and the retry reuses the SAME subnet
+        # instead of leaking one per attempt
+        fc.fail_code = 500
+        with pytest.raises(ApiError):
+            ctl.sync("n1")
+        assert ctl._issued == {"10.244.0.0/24": "n1"}
+        fc.fail_code = 0
+        ctl.sync("n1")
+        # the guarded first subnet was handed out again, not leaked
+        assert list(ctl._issued) == ["10.244.0.0/24"]
+        # node deletion prunes its issued entries
+        del Inf.store.nodes["n1"]
+        ctl.sync("n1")
+        assert ctl._issued == {}
+
+    def test_volume_manager_resolves_pvc_outside_lock(self, tmp_path):
+        from kubernetes_tpu.volume import VolumeManager
+        vm = VolumeManager(str(tmp_path / "kubelet"))
+
+        class Resolver:
+            def __init__(self, vm):
+                self.vm = vm
+                self.lock_was_free = None
+
+            def get(self, resource, name, ns=""):
+                free = self.vm._lock.acquire(blocking=False)
+                if free:
+                    self.vm._lock.release()
+                self.lock_was_free = free
+                if resource == "persistentvolumeclaims":
+                    return api.PersistentVolumeClaim(
+                        metadata=api.ObjectMeta(name=name, namespace=ns),
+                        spec=api.PersistentVolumeClaimSpec(
+                            volume_name="pv1"))
+                return api.PersistentVolume(
+                    metadata=api.ObjectMeta(name=name),
+                    spec=api.PersistentVolumeSpec(
+                        host_path=api.HostPathVolumeSource(
+                            path=str(tmp_path / "pv-data"))))
+
+        vm.resolver = Resolver(vm)
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(
+                volumes=[api.Volume(
+                    name="data",
+                    persistent_volume_claim=api.
+                    PersistentVolumeClaimVolumeSource(claim_name="cl"))],
+                containers=[api.Container(
+                    name="c", image="pause",
+                    volume_mounts=[api.VolumeMount(name="data",
+                                                   mount_path="/data")])]))
+        views = vm.setup_pod(pod)
+        assert vm.resolver.lock_was_free is True, \
+            "PVC resolution must not run under the manager-wide lock"
+        assert views["c"]["/data"] == str(tmp_path / "pv-data")
+        assert vm.mounted("default/p")
+
+    def test_tls_skip_verify_is_explicit_and_counted(self):
+        class SecureStub:
+            secure = True
+            port = 1
+
+        c = RESTClient.for_server(SecureStub())
+        assert c.tls and not c.insecure_skip_verify, \
+            "secure server must no longer imply skip-verify"
+        before = METRICS.counter_value("tls_insecure_connections")
+        insecure = RESTClient(tls=True, insecure_skip_verify=True)
+        insecure._new_conn(1.0)
+        assert METRICS.counter_value("tls_insecure_connections") == before + 1
