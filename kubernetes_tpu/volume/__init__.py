@@ -64,6 +64,29 @@ class VolumeManager:
         self._mounted: Dict[str, Dict[str, str]] = {}
         # pod key -> paths owned by the manager (deleted on teardown)
         self._owned: Dict[str, List[str]] = {}
+        # per-pod serialization: setup vs teardown of the SAME pod must not
+        # interleave (a teardown slipping between materialization and book
+        # registration would find nothing to remove and the dirs would
+        # leak). Entries are refcounted so a key's lock object is removed
+        # only when its last holder/waiter leaves — popping earlier would
+        # let a third caller mint a fresh lock and bypass a live holder.
+        self._pod_locks: Dict[str, list] = {}  # key -> [Lock, refcount]
+
+    def _pod_lock(self, key: str) -> threading.Lock:
+        with self._lock:
+            ent = self._pod_locks.get(key)
+            if ent is None:
+                ent = self._pod_locks[key] = [threading.Lock(), 0]
+            ent[1] += 1
+            return ent[0]
+
+    def _release_pod_lock(self, key: str) -> None:
+        with self._lock:
+            ent = self._pod_locks.get(key)
+            if ent is not None:
+                ent[1] -= 1
+                if ent[1] <= 0:
+                    del self._pod_locks[key]
 
     # -- plugin dispatch -------------------------------------------------------
 
@@ -141,69 +164,90 @@ class VolumeManager:
         spec = pod.spec
         if spec is None:
             return {}
+        # materialization does filesystem work and — for PVCs — apiserver
+        # HTTP through the resolver. It runs OUTSIDE the manager-wide lock:
+        # one slow claim lookup must not stall every other pod's volume
+        # lifecycle on this kubelet (round-5 ADVICE). Only the PER-POD lock
+        # is held, serializing setup vs teardown of this one pod; the
+        # manager lock guards just the _mounted/_owned books.
+        lk = self._pod_lock(key)
+        try:
+            with lk:
+                return self._setup_pod_locked(key, pod, spec)
+        finally:
+            self._release_pod_lock(key)
+
+    def _setup_pod_locked(self, key: str, pod: api.Pod,
+                          spec: api.PodSpec) -> Dict[str, Dict[str, str]]:
+        vols: Dict[str, str] = {}
+        owned: List[str] = []
+        try:
+            for vol in spec.volumes or []:
+                path, is_owned = self._materialize(key, pod, vol)
+                vols[vol.name] = path
+                if is_owned:
+                    owned.append(path)
+            views: Dict[str, Dict[str, str]] = {}
+            pod_dir = os.path.join(self.root, key.replace("/", "_"))
+            for c in spec.containers or []:
+                view_dir = os.path.join(pod_dir, "mounts", c.name)
+                os.makedirs(view_dir, exist_ok=True)
+                entries: Dict[str, str] = {}
+                seen_links: Dict[str, str] = {}
+                for m in c.volume_mounts or []:
+                    src = vols.get(m.name)
+                    if src is None:
+                        raise VolumeError(
+                            f"container {c.name!r} mounts unknown "
+                            f"volume {m.name!r}")
+                    entry = _mount_entry_name(m.mount_path)
+                    if entry in seen_links:
+                        raise VolumeError(
+                            f"container {c.name!r}: mount paths "
+                            f"{seen_links[entry]!r} and "
+                            f"{m.mount_path!r} collide in the view "
+                            f"(both map to {entry!r})")
+                    seen_links[entry] = m.mount_path
+                    link = os.path.join(view_dir, entry)
+                    if os.path.islink(link):
+                        os.unlink(link)
+                    os.symlink(src, link)
+                    entries[m.mount_path] = src
+                views[c.name] = entries
+        except (VolumeError, OSError):
+            # rollback: manager-created paths from earlier volumes of
+            # this failed setup must not leak (OSError too — a failed
+            # symlink/mkdir must not skip it)
+            for path in owned:
+                if not self._in_attach_root(path):
+                    shutil.rmtree(path, ignore_errors=True)
+            pod_dir = os.path.join(self.root, key.replace("/", "_"))
+            shutil.rmtree(os.path.join(pod_dir, "mounts"),
+                          ignore_errors=True)
+            raise
         with self._lock:
-            vols: Dict[str, str] = {}
-            owned: List[str] = []
-            try:
-                for vol in spec.volumes or []:
-                    path, is_owned = self._materialize(key, pod, vol)
-                    vols[vol.name] = path
-                    if is_owned:
-                        owned.append(path)
-                views: Dict[str, Dict[str, str]] = {}
-                pod_dir = os.path.join(self.root, key.replace("/", "_"))
-                for c in spec.containers or []:
-                    view_dir = os.path.join(pod_dir, "mounts", c.name)
-                    os.makedirs(view_dir, exist_ok=True)
-                    entries: Dict[str, str] = {}
-                    seen_links: Dict[str, str] = {}
-                    for m in c.volume_mounts or []:
-                        src = vols.get(m.name)
-                        if src is None:
-                            raise VolumeError(
-                                f"container {c.name!r} mounts unknown "
-                                f"volume {m.name!r}")
-                        entry = _mount_entry_name(m.mount_path)
-                        if entry in seen_links:
-                            raise VolumeError(
-                                f"container {c.name!r}: mount paths "
-                                f"{seen_links[entry]!r} and "
-                                f"{m.mount_path!r} collide in the view "
-                                f"(both map to {entry!r})")
-                        seen_links[entry] = m.mount_path
-                        link = os.path.join(view_dir, entry)
-                        if os.path.islink(link):
-                            os.unlink(link)
-                        os.symlink(src, link)
-                        entries[m.mount_path] = src
-                    views[c.name] = entries
-            except (VolumeError, OSError):
-                # rollback: manager-created paths from earlier volumes of
-                # this failed setup must not leak (OSError too — a failed
-                # symlink/mkdir must not skip it)
-                for path in owned:
-                    if not self._in_attach_root(path):
-                        shutil.rmtree(path, ignore_errors=True)
-                pod_dir = os.path.join(self.root, key.replace("/", "_"))
-                shutil.rmtree(os.path.join(pod_dir, "mounts"),
-                              ignore_errors=True)
-                raise
             self._mounted[key] = vols
             self._owned[key] = owned
-            return views
+        return views
 
     def teardown_pod(self, key: str) -> None:
         """emptyDir contents die with the pod; attached/hostPath survive
         (the reference reclaims PVs via the recycler, not the kubelet)."""
-        with self._lock:
-            self._mounted.pop(key, None)
-            owned = self._owned.pop(key, [])
-        pod_dir = os.path.join(self.root, key.replace("/", "_"))
-        for path in owned:
-            if self._in_attach_root(path):
-                continue  # attach bookkeeping outlives the pod
-            shutil.rmtree(path, ignore_errors=True)
-        shutil.rmtree(os.path.join(pod_dir, "mounts"), ignore_errors=True)
+        lk = self._pod_lock(key)
+        try:
+            with lk:
+                with self._lock:
+                    self._mounted.pop(key, None)
+                    owned = self._owned.pop(key, [])
+                pod_dir = os.path.join(self.root, key.replace("/", "_"))
+                for path in owned:
+                    if self._in_attach_root(path):
+                        continue  # attach bookkeeping outlives the pod
+                    shutil.rmtree(path, ignore_errors=True)
+                shutil.rmtree(os.path.join(pod_dir, "mounts"),
+                              ignore_errors=True)
+        finally:
+            self._release_pod_lock(key)
 
     def mounted(self, key: str) -> Dict[str, str]:
         with self._lock:
