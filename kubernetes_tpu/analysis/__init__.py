@@ -1,0 +1,44 @@
+"""kube-verify: repo-native static analysis for the control plane.
+
+The reference keeps 900k LoC of concurrent Go honest with a `hack/verify-*`
+battery plus `go test -race`; this package is our equivalent, specialized to
+the bug classes THIS codebase has actually shipped (round-5 ADVICE):
+
+- ``lock-held-across-io``    a ``with <lock>:`` body that performs blocking
+                             I/O (RESTClient verbs, sockets, subprocess,
+                             ``time.sleep``, device syncs) — the exact
+                             volume-manager bug
+- ``informer-cache-mutation``  mutating an object obtained from an informer
+                             store/lister without ``deep_copy``
+- ``host-sync-in-kernel``    host/device sync points (``.item()``,
+                             ``np.asarray``, traced-value branching) inside
+                             the jitted kernel call graph of any
+                             jax-importing module (``ops/`` in practice)
+- ``swallowed-exception``    bare/overbroad ``except`` that silently
+                             discards errors
+- ``monotonic-duration``     ``time.time()`` used for durations instead of
+                             ``time.monotonic()``
+- ``nondaemon-thread``       threads created without explicit ``daemon=``
+
+Run it: ``python -m kubernetes_tpu.analysis kubernetes_tpu/``
+Suppress a finding in place: ``# kube-verify: disable=<check>`` (same line),
+``# kube-verify: disable-next-line=<check>``, or a file-level
+``# kube-verify: disable-file=<check>``.
+Grandfathered findings live in ``analysis/baseline.json`` (see
+``--write-baseline``); the self-hosting gate in tests/test_static_analysis.py
+keeps the package itself at zero non-baselined findings.
+
+The runtime half — the lock-order tracker and checked informer store that
+tests run under (our ``go test -race`` stand-in) — is in
+``kubernetes_tpu.analysis.runtime``.
+"""
+
+from kubernetes_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Checker,
+    Finding,
+    all_checkers,
+    analyze_paths,
+    analyze_source,
+    default_baseline_path,
+)

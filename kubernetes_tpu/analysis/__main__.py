@@ -1,0 +1,99 @@
+"""CLI: python -m kubernetes_tpu.analysis [paths...]
+
+Exit codes (stable — tools/verify.sh and CI key off them):
+  0  clean (no non-baselined findings)
+  1  findings
+  2  usage / IO error
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from kubernetes_tpu.analysis.core import (
+    Baseline,
+    all_checkers,
+    analyze_paths,
+    default_baseline_path,
+)
+from kubernetes_tpu.analysis.report import render_json, render_text
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.analysis",
+        description="kube-verify: repo-native static analysis")
+    parser.add_argument("paths", nargs="*", default=["kubernetes_tpu"],
+                        help="files or directories (default: kubernetes_tpu)")
+    parser.add_argument("--json", action="store_true",
+                        help="JSON report instead of text")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: the checked-in "
+                             "analysis/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report grandfathered findings as failures too")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="grandfather all current findings into the "
+                             "baseline file and exit 0")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated checker names to run")
+    parser.add_argument("--disable", default=None,
+                        help="comma-separated checker names to skip")
+    parser.add_argument("--list-checks", action="store_true")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="include baselined findings in the text report")
+    args = parser.parse_args(argv)
+
+    checkers = all_checkers()
+    if args.list_checks:
+        for c in checkers:
+            print(f"{c.name}: {c.description}")
+        return 0
+    if args.select:
+        wanted = {s.strip() for s in args.select.split(",")}
+        unknown = wanted - {c.name for c in checkers}
+        if unknown:
+            print(f"unknown checker(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        checkers = [c for c in checkers if c.name in wanted]
+    if args.disable:
+        skip = {s.strip() for s in args.disable.split(",")}
+        checkers = [c for c in checkers if c.name not in skip]
+
+    import os
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        for p in missing:
+            print(f"no such file or directory: {p}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = Baseline() if args.no_baseline else Baseline.load(baseline_path)
+
+    results = analyze_paths(args.paths, checkers=checkers, baseline=baseline)
+
+    io_errors = [f for f in results["new"] if f.check == "read-error"]
+    if io_errors:
+        for f in io_errors:
+            print(f"{f.path}: {f.message}", file=sys.stderr)
+        return 2  # IO error, per the documented exit-code contract
+
+    if args.write_baseline:
+        Baseline.write(baseline_path,
+                       results["new"] + results["baselined"])
+        print(f"wrote {len(results['new']) + len(results['baselined'])} "
+              f"finding(s) to {baseline_path}")
+        return 0
+
+    if args.json:
+        render_json(results, sys.stdout)
+    else:
+        render_text(results, sys.stdout,
+                    verbose_baselined=args.show_baselined)
+    return 1 if results["new"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,222 @@
+"""informer-cache-mutation: in-place mutation of shared informer-cache objects.
+
+Informer stores hand out *references* — every controller, the scheduler,
+and kubectl printers see the same object the watch delivered. A controller
+that does ``pod.status = ...`` on a store-read object corrupts every other
+reader's view (and the next relist diff). The reference enforces this by
+convention plus the race detector; here the convention is checkable:
+
+    node = self.node_informer.store.get(name)      # tainted
+    node.status = ...                              # FINDING
+    fresh = deep_copy(node)                        # fresh is clean
+    fresh.status = ...                             # fine
+
+Function-local taint tracking, statement order as control-flow proxy:
+
+- taint sources: ``.get/.list/.list_all/.by_index/.get_pod_*`` calls whose
+  receiver text names a store/lister/informer
+- propagation: aliasing (``x = tainted``), sub-object access
+  (``st = pod.status``), iteration (``for p in tainted_list``),
+  comprehensions and ``list()/sorted()`` over tainted collections
+- sanitizers: ``deep_copy``/``deepcopy`` (any dotted spelling)
+- violations: attribute/subscript assignment or augmented assignment
+  through a tainted name, and mutating-method calls
+  (``.append/.update/...``) on a tainted name's sub-objects
+
+Mutations routed through helper calls (``self._mutate(pod)``) are invisible
+to this pass — the checked-store mode in analysis.runtime catches those at
+test time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from kubernetes_tpu.analysis.core import (
+    Checker,
+    FileContext,
+    Finding,
+    dotted_chain,
+)
+
+_READ_METHODS = {"get", "list", "list_all", "by_index", "get_pod_services",
+                 "get_pod_controllers", "get_pod_replica_sets"}
+_SOURCE_WORDS = ("store", "lister", "informer")
+_SANITIZERS = {"deep_copy", "deepcopy"}
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "update", "setdefault", "sort", "reverse", "add", "discard",
+             "popitem"}
+# list()/sorted() copy the container, not the elements — taint flows through
+_CONTAINER_COPIES = {"list", "sorted", "tuple", "reversed"}
+
+
+def _is_store_read(call: ast.Call) -> bool:
+    chain = dotted_chain(call.func)
+    if not chain or len(chain) < 2 or chain[-1] not in _READ_METHODS:
+        return False
+    receiver = ".".join(chain[:-1]).lower()
+    return any(w in receiver for w in _SOURCE_WORDS)
+
+
+def _is_sanitizer(call: ast.Call) -> bool:
+    chain = dotted_chain(call.func)
+    return bool(chain) and chain[-1] in _SANITIZERS
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an attribute/subscript chain, if any."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _FunctionPass:
+    """One linear pass over a function body (statement order ≈ execution
+    order — good enough for a heuristic that prefers false negatives)."""
+
+    def __init__(self, checker: "CacheMutationChecker", ctx: FileContext):
+        self.checker = checker
+        self.ctx = ctx
+        self.tainted: Set[str] = set()        # names bound to cache objects
+        self.collections: Set[str] = set()    # names bound to lists of them
+        self.findings = []
+
+    # --- taint classification of an expression --------------------------------
+
+    def _value_taint(self, value: ast.AST) -> str:
+        """'' | 'object' | 'collection' for the value being bound."""
+        if isinstance(value, ast.Call):
+            if _is_sanitizer(value):
+                return ""
+            if _is_store_read(value):
+                chain = dotted_chain(value.func)
+                return "object" if chain[-1] == "get" else "collection"
+            chain = dotted_chain(value.func)
+            if chain and len(chain) == 1 and chain[0] in _CONTAINER_COPIES \
+                    and value.args:
+                inner = self._value_taint(value.args[0])
+                return "collection" if inner else ""
+            return ""
+        if isinstance(value, ast.Name):
+            if value.id in self.tainted:
+                return "object"
+            if value.id in self.collections:
+                return "collection"
+            return ""
+        if isinstance(value, ast.Attribute):
+            root = _root_name(value)
+            # sub-objects of a tainted object are tainted (pod.status);
+            # their list-valued fields are shared collections
+            return "object" if root in self.tainted else ""
+        if isinstance(value, (ast.ListComp, ast.GeneratorExp)):
+            for gen in value.generators:
+                if self._value_taint(gen.iter) == "collection" and \
+                        isinstance(value.elt, ast.Name):
+                    return "collection"
+            return ""
+        if isinstance(value, ast.BoolOp):
+            # `x = maybe_tainted or default`
+            return ("object" if any(self._value_taint(v) == "object"
+                                    for v in value.values) else "")
+        if isinstance(value, ast.IfExp):
+            if any(self._value_taint(v) for v in (value.body, value.orelse)):
+                return self._value_taint(value.body) or \
+                    self._value_taint(value.orelse)
+            return ""
+        return ""
+
+    def _bind(self, target: ast.AST, taint: str):
+        if not isinstance(target, ast.Name):
+            return
+        self.tainted.discard(target.id)
+        self.collections.discard(target.id)
+        if taint == "object":
+            self.tainted.add(target.id)
+        elif taint == "collection":
+            self.collections.add(target.id)
+
+    # --- statement walk -------------------------------------------------------
+
+    def run(self, fn: ast.FunctionDef):
+        self._visit_body(fn.body)
+        return self.findings
+
+    def _visit_body(self, body):
+        for stmt in body:
+            self._visit_stmt(stmt)
+
+    def _visit_stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, ast.Assign):
+            taint = self._value_taint(stmt.value)
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self._bind(tgt, taint)
+                else:
+                    self._flag_mutation(tgt, stmt)
+            self._scan_calls(stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                pass  # rebinding a local, not mutating a cache object
+            else:
+                self._flag_mutation(stmt.target, stmt)
+        elif isinstance(stmt, ast.For):
+            taint = self._value_taint(stmt.iter)
+            self._bind(stmt.target,
+                       "object" if taint == "collection" else "")
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            self._visit_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._visit_body(stmt.body)
+            for h in stmt.handlers:
+                self._visit_body(h.body)
+            self._visit_body(stmt.orelse)
+            self._visit_body(stmt.finalbody)
+        elif isinstance(stmt, ast.Expr):
+            self._scan_calls(stmt.value)
+        # nested defs get their own pass from the checker's top-level walk
+
+    def _flag_mutation(self, target: ast.AST, stmt: ast.stmt):
+        root = _root_name(target)
+        if root in self.tainted:
+            self.findings.append(self.checker.finding(
+                self.ctx, stmt,
+                f"'{root}' was read from an informer store/lister and is "
+                "mutated in place — deep_copy() it first (shared cache "
+                "object; every other reader sees this write)"))
+
+    def _scan_calls(self, expr: ast.AST):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_chain(node.func)
+            if not chain or len(chain) < 2 or chain[-1] not in _MUTATORS:
+                continue
+            root = chain[0]
+            # require a sub-object hop (pod.metadata.labels.update) or a
+            # direct mutator on a tainted object; a mutator on a tainted
+            # COLLECTION (pods.append) touches our copy of the list, not
+            # the cached objects
+            if root in self.tainted:
+                self.findings.append(self.checker.finding(
+                    self.ctx, node,
+                    f"'{'.'.join(chain[:-1])}' belongs to a cache object "
+                    f"read from an informer store/lister; .{chain[-1]}() "
+                    "mutates shared state — deep_copy() the object first"))
+
+
+class CacheMutationChecker(Checker):
+    name = "informer-cache-mutation"
+    description = ("in-place mutation of an object read from an informer "
+                   "store/lister without deep_copy")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from _FunctionPass(self, ctx).run(node)

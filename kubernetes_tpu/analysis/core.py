@@ -1,0 +1,274 @@
+"""Checker framework: findings, suppressions, baseline, and the runner.
+
+Stdlib-only by design (``ast`` + ``re``): the analyzer must run in any
+environment the code itself runs in, including CI images with nothing but
+the interpreter.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str        # checker id, e.g. "lock-held-across-io"
+    path: str         # path as given to the runner (repo-relative in CI)
+    line: int         # 1-based
+    col: int          # 0-based, ast convention
+    message: str
+    snippet: str = ""  # stripped source line — the baseline fingerprint input
+
+    def fingerprint(self) -> str:
+        """Stable identity that survives unrelated edits: the line NUMBER is
+        deliberately excluded so code moving around doesn't churn the
+        baseline; the normalized source line is included so the baseline
+        entry dies with the code it grandfathered. The path contributes its
+        last two components — stable across absolute/relative invocation
+        styles, while same-named files in different packages (every
+        __init__.py) don't collide."""
+        tail = "/".join(self.path.replace("\\", "/").split("/")[-2:])
+        raw = f"{self.check}|{tail}|{' '.join(self.snippet.split())}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"check": self.check, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "fingerprint": self.fingerprint()}
+
+
+class Checker:
+    """Base checker: subclasses set ``name``/``description`` and implement
+    ``check(tree, ctx)`` yielding Findings. Register by listing the class in
+    ``all_checkers()`` — the CLI, the self-hosting gate, and ``--list-checks``
+    all read from there."""
+
+    name = ""
+    description = ""
+
+    def check(self, tree: ast.Module, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(check=self.name, path=ctx.path, line=line,
+                       col=getattr(node, "col_offset", 0), message=message,
+                       snippet=ctx.line(line))
+
+
+@dataclass
+class FileContext:
+    path: str
+    source: str
+    lines: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+# --- suppressions -------------------------------------------------------------
+
+_DIRECTIVE = re.compile(
+    r"#\s*kube-verify:\s*(disable|disable-next-line|disable-file)"
+    r"\s*=\s*([\w,\- ]+)")
+
+
+class Suppressions:
+    """Per-file suppression directives parsed from comments."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.file_wide: Set[str] = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _DIRECTIVE.search(text)
+            if not m:
+                continue
+            kind, checks = m.group(1), {
+                c.strip() for c in m.group(2).split(",") if c.strip()}
+            if kind == "disable":
+                self.by_line.setdefault(i, set()).update(checks)
+            elif kind == "disable-next-line":
+                self.by_line.setdefault(i + 1, set()).update(checks)
+            else:
+                self.file_wide.update(checks)
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.check in self.file_wide or "all" in self.file_wide:
+            return True
+        checks = self.by_line.get(finding.line, ())
+        return finding.check in checks or "all" in checks
+
+
+# --- baseline -----------------------------------------------------------------
+
+class Baseline:
+    """Checked-in ledger of grandfathered findings. A finding whose
+    fingerprint appears here is reported as baselined (not a failure);
+    fixing the code removes the line, and ``--write-baseline`` regenerates
+    the file. New code should never grow the baseline — fix or suppress
+    with an in-line justification instead."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries = entries or []
+        self._fps = {e["fingerprint"] for e in self.entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("findings", []))
+
+    def contains(self, finding: Finding) -> bool:
+        return finding.fingerprint() in self._fps
+
+    @staticmethod
+    def write(path: str, findings: Sequence[Finding]) -> None:
+        data = {
+            "version": 1,
+            "comment": "grandfathered kube-verify findings; regenerate with "
+                       "`python -m kubernetes_tpu.analysis --write-baseline`",
+            "findings": [{
+                "check": f.check, "path": f.path,
+                "fingerprint": f.fingerprint(),
+                "snippet": f.snippet,
+            } for f in sorted(findings, key=lambda f: (f.path, f.line))],
+        }
+        with open(path, "w") as fh:
+            json.dump(data, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+# --- runner -------------------------------------------------------------------
+
+def all_checkers() -> List[Checker]:
+    # imported here, not at module top: each checker module imports core
+    from kubernetes_tpu.analysis.cache_mutation import CacheMutationChecker
+    from kubernetes_tpu.analysis.hostsync import HostSyncChecker
+    from kubernetes_tpu.analysis.hygiene import (
+        MonotonicDurationChecker,
+        NonDaemonThreadChecker,
+        SwallowedExceptionChecker,
+    )
+    from kubernetes_tpu.analysis.locks import LockHeldAcrossIOChecker
+    return [
+        LockHeldAcrossIOChecker(),
+        CacheMutationChecker(),
+        HostSyncChecker(),
+        SwallowedExceptionChecker(),
+        MonotonicDurationChecker(),
+        NonDaemonThreadChecker(),
+    ]
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   checkers: Optional[Sequence[Checker]] = None,
+                   ) -> List[Finding]:
+    """Run checkers over one source blob; suppressions applied, baseline not
+    (the baseline is a repo-level concern, see analyze_paths)."""
+    checkers = list(checkers) if checkers is not None else all_checkers()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(check="parse-error", path=path, line=e.lineno or 1,
+                        col=e.offset or 0, message=f"syntax error: {e.msg}",
+                        snippet="")]
+    ctx = FileContext(path=path, source=source)
+    sup = Suppressions(source)
+    out: List[Finding] = []
+    for checker in checkers:
+        for f in checker.check(tree, ctx):
+            if not sup.suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.line, f.col, f.check))
+    return out
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def analyze_paths(paths: Sequence[str],
+                  checkers: Optional[Sequence[Checker]] = None,
+                  baseline: Optional[Baseline] = None,
+                  ) -> Dict[str, List[Finding]]:
+    """Analyze files/trees. Returns {"new": [...], "baselined": [...]}."""
+    baseline = baseline or Baseline()
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for fp in iter_python_files(paths):
+        try:
+            with open(fp, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            new.append(Finding(check="read-error", path=fp, line=1, col=0,
+                               message=str(e)))
+            continue
+        for finding in analyze_source(source, path=fp, checkers=checkers):
+            (old if baseline.contains(finding) else new).append(finding)
+    return {"new": new, "baselined": old}
+
+
+# --- shared AST helpers used by several checkers ------------------------------
+
+def dotted_chain(node: ast.AST) -> Optional[List[str]]:
+    """['self', 'client', 'get'] for self.client.get — None if the
+    expression isn't a plain dotted name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def chain_text(node: ast.AST) -> str:
+    chain = dotted_chain(node)
+    return ".".join(chain) if chain else ""
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def walk_same_scope(node: ast.AST) -> Iterable[ast.AST]:
+    """Like ast.walk but does not descend into nested function/class scopes
+    (a lock held here says nothing about code that merely gets DEFINED
+    here)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(child))

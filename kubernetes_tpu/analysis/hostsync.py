@@ -1,0 +1,282 @@
+"""host-sync-in-kernel: host/device sync points inside the jitted kernel path.
+
+A ``.item()``, ``np.asarray(traced)``, ``float(traced)``, or a Python
+branch on a traced value inside the jit boundary either fails tracing
+outright or — worse — silently bakes one batch's values into the compiled
+program (a constant-folded kernel that "works" until the second batch).
+On the bench path this is also the classic compile-cache poison: the
+traced-in constant changes the program hash every solve.
+
+Scope: modules that import jax. The checker finds jit roots
+(``@jax.jit``, ``@functools.partial(jax.jit, static_argnames=...)``,
+``x = jax.jit(fn)``), computes the local call graph reachable from them
+(helpers called from inside the kernel are kernel too), and inside that
+set flags:
+
+- ``.item()`` / ``jax.device_get`` / ``.block_until_ready()`` — explicit
+  device syncs
+- ``np.asarray`` / ``np.array`` of a non-literal — device→host transfer
+  (literal lists are host constants and fine)
+- ``float()/int()/bool()`` of a non-literal — implicit sync; shape/dtype
+  metadata (``x.shape``, ``len(x)``, ``x.ndim``) is static and exempt
+- in jit ROOT functions only (where tracedness is known from the
+  signature): ``if``/``while`` tests that reference a non-static
+  parameter directly — branch on ``jnp.where``/``lax.cond`` instead
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from kubernetes_tpu.analysis.core import (
+    Checker,
+    FileContext,
+    Finding,
+    dotted_chain,
+    walk_same_scope,
+)
+
+_STATIC_META_ATTRS = {"shape", "dtype", "ndim", "size"}
+_CAST_FUNCS = {"float", "int", "bool"}
+_NP_TRANSFER = {"asarray", "array"}
+
+
+def _imports_jax(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax"
+                                or node.module.startswith("jax.")):
+                return True
+    return False
+
+
+def _jit_static_argnames(call: ast.Call) -> List[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            try:
+                v = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError, TypeError):
+                return []
+            if isinstance(v, str):
+                return [v]
+            if isinstance(v, (list, tuple)):
+                return [s for s in v if isinstance(s, str)]
+    return []
+
+
+def _jit_decoration(dec: ast.AST) -> Optional[List[str]]:
+    """static_argnames if `dec` is a jit decorator, else None."""
+    chain = dotted_chain(dec)
+    if chain and chain[-1] == "jit":
+        return []
+    if isinstance(dec, ast.Call):
+        inner = dotted_chain(dec.func)
+        if inner and inner[-1] == "jit":
+            return _jit_static_argnames(dec)
+        if inner and inner[-1] == "partial" and dec.args:
+            first = dotted_chain(dec.args[0])
+            if first and first[-1] == "jit":
+                return _jit_static_argnames(dec)
+    return None
+
+
+def _is_literalish(node: ast.AST) -> bool:
+    """Host constants: literals, and lists/tuples of literalish things."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literalish(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literalish(node.operand)
+    return False
+
+
+def _is_static_metadata(node: ast.AST) -> bool:
+    """x.shape / x.shape[0] / len(x) / x.ndim — static under tracing."""
+    if isinstance(node, ast.Subscript):
+        return _is_static_metadata(node.value)
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_META_ATTRS
+    if isinstance(node, ast.Call):
+        chain = dotted_chain(node.func)
+        if chain and chain[-1] in ("len", "range", "enumerate"):
+            return True
+    if isinstance(node, ast.BinOp):
+        return (_is_static_metadata(node.left)
+                or _is_static_metadata(node.right))
+    return False
+
+
+class HostSyncChecker(Checker):
+    name = "host-sync-in-kernel"
+    description = ("host/device sync (.item(), np.asarray, float(), traced "
+                   "branching) inside the jitted kernel path")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        if not _imports_jax(tree):
+            return
+        functions: Dict[str, ast.FunctionDef] = {}
+        roots: Dict[str, List[str]] = {}  # fn name -> static_argnames
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                functions.setdefault(node.name, node)
+                for dec in node.decorator_list:
+                    statics = _jit_decoration(dec)
+                    if statics is not None:
+                        roots[node.name] = statics
+            elif isinstance(node, ast.Call):
+                # jitted = jax.jit(fn, ...)
+                chain = dotted_chain(node.func)
+                if chain and chain[-1] == "jit" and node.args and \
+                        isinstance(node.args[0], ast.Name):
+                    roots[node.args[0].id] = _jit_static_argnames(node)
+
+        kernel: Set[str] = set(roots)
+        frontier = list(roots)
+        while frontier:
+            fn = functions.get(frontier.pop())
+            if fn is None:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    chain = dotted_chain(sub.func)
+                    if chain and len(chain) == 1 and \
+                            chain[0] in functions and chain[0] not in kernel:
+                        kernel.add(chain[0])
+                        frontier.append(chain[0])
+
+        for name in sorted(kernel):
+            fn = functions.get(name)
+            if fn is None:
+                continue
+            yield from self._check_kernel_fn(
+                fn, ctx, statics=roots.get(name), is_root=name in roots)
+
+    @staticmethod
+    def _host_list_names(fn) -> Set[str]:
+        """Names bound to Python lists built in this function (``chans =
+        []`` + appends): np.asarray of those is a host constant, not a
+        device transfer."""
+        def is_host_list(value) -> bool:
+            if isinstance(value, (ast.List, ast.ListComp)):
+                return True
+            if isinstance(value, ast.Call):
+                return dotted_chain(value.func) in (["list"], ["range"])
+            return False
+
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.AnnAssign) and node.value is not None:
+                if is_host_list(node.value) and \
+                        isinstance(node.target, ast.Name):
+                    out.add(node.target.id)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and is_host_list(node.value):
+                        out.add(tgt.id)
+                    elif isinstance(tgt, ast.Tuple) and \
+                            isinstance(node.value, ast.Tuple) and \
+                            len(tgt.elts) == len(node.value.elts):
+                        out.update(
+                            t.id for t, v in zip(tgt.elts, node.value.elts)
+                            if isinstance(t, ast.Name) and is_host_list(v))
+        return out
+
+    def _check_kernel_fn(self, fn, ctx: FileContext,
+                         statics: Optional[List[str]],
+                         is_root: bool) -> Iterable[Finding]:
+        host_lists = self._host_list_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(node, ctx, fn.name, host_lists)
+        if not is_root:
+            return
+        traced = {a.arg for a in
+                  list(fn.args.args) + list(fn.args.kwonlyargs)}
+        traced -= set(statics or ())
+        traced.discard("self")
+        for node in walk_same_scope(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                bad = self._traced_name_in_test(node.test, traced)
+                if bad:
+                    yield self.finding(
+                        ctx, node,
+                        f"branching on traced value '{bad}' inside jitted "
+                        f"'{fn.name}' — use jnp.where/lax.cond, or declare "
+                        "it in static_argnames")
+
+    @classmethod
+    def _traced_name_in_test(cls, test: ast.AST,
+                             traced: Set[str]) -> Optional[str]:
+        """A traced param referenced by VALUE in a branch test. References
+        through static metadata (x.shape, x.dtype, x.ndim, len(x)) don't
+        count — those are concrete under tracing."""
+        if isinstance(test, ast.Attribute) and \
+                test.attr in _STATIC_META_ATTRS:
+            return None
+        if isinstance(test, ast.Call):
+            chain = dotted_chain(test.func)
+            if chain and chain[-1] in ("len", "isinstance", "hasattr",
+                                       "issubdtype", "getattr"):
+                return None
+        if isinstance(test, ast.Name):
+            return test.id if test.id in traced else None
+        for child in ast.iter_child_nodes(test):
+            hit = cls._traced_name_in_test(child, traced)
+            if hit:
+                return hit
+        return None
+
+    def _check_call(self, call: ast.Call, ctx: FileContext,
+                    fn_name: str,
+                    host_lists: Set[str] = frozenset()) -> Iterable[Finding]:
+        chain = dotted_chain(call.func)
+        where = f"inside kernel-path function '{fn_name}'"
+        if not chain:
+            # method on a computed receiver, e.g. x.sum().item()
+            if isinstance(call.func, ast.Attribute):
+                if call.func.attr == "item":
+                    yield self.finding(
+                        ctx, call,
+                        f".item() {where} forces a device→host sync")
+                elif call.func.attr == "block_until_ready":
+                    yield self.finding(
+                        ctx, call,
+                        f".block_until_ready() {where} blocks on the device "
+                        "— sync at the dispatch boundary instead")
+            return
+        last = chain[-1]
+        if last == "item" and len(chain) > 1:
+            yield self.finding(ctx, call,
+                               f".item() {where} forces a device→host sync")
+        elif last == "block_until_ready" and len(chain) > 1:
+            yield self.finding(
+                ctx, call, f".block_until_ready() {where} blocks on the "
+                "device — sync at the dispatch boundary instead")
+        elif chain[:2] == ["jax", "device_get"]:
+            yield self.finding(ctx, call,
+                               f"jax.device_get() {where} is a host transfer")
+        elif len(chain) == 2 and chain[0] in ("np", "numpy") and \
+                last in _NP_TRANSFER:
+            arg = call.args[0] if call.args else None
+            host_const = arg is not None and (
+                _is_literalish(arg)
+                or (isinstance(arg, ast.Name) and arg.id in host_lists))
+            if arg is not None and not host_const:
+                yield self.finding(
+                    ctx, call,
+                    f"np.{last}() of a non-literal {where} pulls the value "
+                    "to host (use jnp, or hoist to the host boundary)")
+        elif len(chain) == 1 and last in _CAST_FUNCS and call.args:
+            arg = call.args[0]
+            if not _is_literalish(arg) and not _is_static_metadata(arg):
+                yield self.finding(
+                    ctx, call,
+                    f"{last}() of a non-literal {where} forces a host sync "
+                    "(shape/dtype metadata is exempt; traced values are not)")

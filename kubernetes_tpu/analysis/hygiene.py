@@ -1,0 +1,150 @@
+"""Controller hygiene: swallowed exceptions, wall-clock durations, threads.
+
+Three small checkers that encode review rules this codebase keeps
+re-learning:
+
+- ``swallowed-exception``: a bare/``Exception``/``BaseException`` handler
+  whose body does NOTHING (only ``pass``/``continue``/``break``/constants)
+  silently discards errors. The sync-loop version of this bug hides a
+  controller that has been failing for hours. Handlers that log, raise,
+  assign a fallback, return a value, or call anything are fine — the rule
+  targets pure swallows.
+
+- ``monotonic-duration``: ``time.time()`` in duration arithmetic
+  (``time.time() - start``, ``deadline > time.time()``) or as a
+  ``clock=time.time`` default jumps with NTP steps — leader leases and
+  eviction timers misfire on clock skew. ``time.monotonic()`` is the
+  duration clock; wall clock is ONLY for timestamps serialized into API
+  objects (suppress those sites with a justification).
+
+- ``nondaemon-thread``: ``threading.Thread(...)`` without an explicit
+  ``daemon=`` keyword. A forgotten non-daemon worker turns every process
+  exit into a hang; writing the choice down is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kubernetes_tpu.analysis.core import (
+    Checker,
+    FileContext,
+    Finding,
+    dotted_chain,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    chain = dotted_chain(handler.type)
+    return bool(chain) and chain[-1] in _BROAD
+
+
+def _stmt_is_inert(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+        return True
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+        return True  # docstring / ellipsis
+    return False
+
+
+class SwallowedExceptionChecker(Checker):
+    name = "swallowed-exception"
+    description = ("bare/overbroad except whose body silently discards the "
+                   "error (no log, no raise, no handling)")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _handler_is_broad(node):
+                continue
+            if all(_stmt_is_inert(s) for s in node.body):
+                what = ("bare except" if node.type is None else
+                        f"except {dotted_chain(node.type)[-1]}")
+                yield self.finding(
+                    ctx, node,
+                    f"{what} swallows the error silently — log it, narrow "
+                    "the exception type, or handle it")
+
+
+class MonotonicDurationChecker(Checker):
+    name = "monotonic-duration"
+    description = ("time.time() used for durations/deadlines — "
+                   "time.monotonic() is immune to wall-clock steps")
+
+    @staticmethod
+    def _is_wallclock_call(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and dotted_chain(node.func) == ["time", "time"])
+
+    @staticmethod
+    def _is_wallclock_ref(node: ast.AST) -> bool:
+        return dotted_chain(node) == ["time", "time"]
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, (ast.Add, ast.Sub)):
+                if self._is_wallclock_call(node.left) or \
+                        self._is_wallclock_call(node.right):
+                    yield self.finding(
+                        ctx, node,
+                        "time.time() in duration arithmetic — use "
+                        "time.monotonic() (wall clock only for serialized "
+                        "API timestamps; suppress with a justification)")
+            elif isinstance(node, ast.Compare):
+                operands = [node.left] + list(node.comparators)
+                if any(self._is_wallclock_call(o) for o in operands):
+                    yield self.finding(
+                        ctx, node,
+                        "time.time() compared against a deadline — "
+                        "monotonic deadlines don't jump with NTP")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                pos = list(args.args)
+                defaults = list(args.defaults)
+                for arg, default in zip(pos[len(pos) - len(defaults):],
+                                        defaults):
+                    if arg.arg == "clock" and self._is_wallclock_ref(default):
+                        yield self.finding(
+                            ctx, default,
+                            "clock=time.time default — components measuring "
+                            "durations should default to time.monotonic "
+                            "(keep wall clock only where values are "
+                            "serialized into API objects)")
+                for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                    if default is not None and arg.arg == "clock" and \
+                            self._is_wallclock_ref(default):
+                        yield self.finding(
+                            ctx, default,
+                            "clock=time.time default — use time.monotonic "
+                            "for duration clocks")
+
+
+class NonDaemonThreadChecker(Checker):
+    name = "nondaemon-thread"
+    description = ("threading.Thread(...) without an explicit daemon= — "
+                   "undeclared thread lifetime blocks process exit")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_chain(node.func)
+            if not chain or chain[-1] != "Thread":
+                continue
+            if len(chain) > 1 and chain[-2] != "threading":
+                continue
+            if not any(kw.arg == "daemon" for kw in node.keywords):
+                yield self.finding(
+                    ctx, node,
+                    "Thread created without daemon= — declare its lifetime "
+                    "(daemon=True, or daemon=False plus join ownership)")

@@ -1,0 +1,153 @@
+"""lock-held-across-io: a ``with <lock>:`` body that performs blocking I/O.
+
+The volume manager shipped exactly this bug (round-5 ADVICE): PVC
+resolution — an apiserver HTTP round-trip — ran under the manager-wide
+lock, so one slow claim stalled every pod's volume lifecycle on the
+kubelet. The checker encodes the pattern syntactically: a with-statement
+whose context expression *names a lock*, whose body (same scope only —
+nested defs execute later) *calls a known-blocking operation*.
+
+Known-blocking (each with its rationale):
+- ``time.sleep``                      the classic
+- ``subprocess.*`` / ``socket.*``     process spawn / network syscalls
+- ``requests.*`` / ``urllib.*`` / ``urlopen``  HTTP libraries
+- HTTP connection verbs (``.request``/``.getresponse`` on a *conn*)
+- RESTClient verbs on a receiver that names a client/resolver —
+  ``self.client.get(...)`` is an apiserver round-trip, not a dict lookup
+- ``.block_until_ready()``            device sync (seconds under load)
+- ``X.wait(...)`` where X is NOT the held lock — ``Condition.wait`` on the
+  held lock releases it (fine); ``Event.wait`` under someone else's lock
+  sleeps while holding it (not fine)
+- ``X.join(...)`` where X names a thread
+
+Indirect blocking (``with lock: self._helper()`` where the helper does the
+I/O) is out of scope for the AST pass — the runtime lock-order tracker and
+review cover that; this checker exists to make the *obvious* version
+impossible to ship again.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from kubernetes_tpu.analysis.core import (
+    Checker,
+    FileContext,
+    Finding,
+    chain_text,
+    dotted_chain,
+    walk_same_scope,
+)
+
+_LOCK_WORDS = ("lock", "mutex")
+_LOCK_EXACT = {"lk", "mu"}
+
+_REST_VERBS = {
+    "get", "create", "update", "update_status", "patch", "delete", "list",
+    "watch", "bind", "get_scale", "update_scale", "request", "get_json",
+}
+
+
+def _is_rest_receiver(receiver_last: str) -> bool:
+    """'client'/'self.client'/'pv_resolver' yes; 'clients' (a dict of
+    clients) and 'restart_counts' (substring trap) no."""
+    return receiver_last.endswith("client") or receiver_last == "resolver" \
+        or receiver_last.endswith("_resolver")
+
+_SOCKET_BLOCKING = {
+    "create_connection", "connect", "accept", "recv", "recv_into", "send",
+    "sendall", "sendto", "getaddrinfo", "gethostbyname",
+}
+
+
+def is_lock_expr(node: ast.AST) -> bool:
+    """Does this with-context expression name a lock? Terminal-segment
+    heuristic: ``self._lock``, ``self._deleted_lock``, ``lk``..."""
+    chain = dotted_chain(node)
+    if not chain:
+        return False
+    last = chain[-1].lower()
+    return last in _LOCK_EXACT or any(w in last for w in _LOCK_WORDS)
+
+
+def blocking_reason(call: ast.Call, held_lock_text: str) -> Optional[str]:
+    chain = dotted_chain(call.func)
+    if not chain:
+        # method on a computed receiver, e.g. kernel(x).block_until_ready()
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr == "block_until_ready":
+                return ".block_until_ready() syncs with the device"
+            if call.func.attr == "getresponse":
+                return ".getresponse() does HTTP I/O"
+        return None
+    head, last = chain[0], chain[-1]
+    receiver = ".".join(chain[:-1])
+    rlow = receiver.lower()
+    if head == "time" and last == "sleep":
+        return "time.sleep() sleeps"
+    if head == "subprocess":
+        return f"subprocess.{last}() spawns a process"
+    if head == "socket" and (last in _SOCKET_BLOCKING or last == "socket"):
+        return f"socket.{last}() does network I/O"
+    if head in ("requests", "urllib") or last == "urlopen":
+        return f"{'.'.join(chain)}() does HTTP I/O"
+    if last == "block_until_ready":
+        return ".block_until_ready() syncs with the device"
+    if last in _SOCKET_BLOCKING and any(
+            w in rlow for w in ("sock", "conn")):
+        return f"{receiver}.{last}() does network I/O"
+    if last in ("getresponse", "putrequest") or (
+            last == "request" and "conn" in rlow):
+        return f"{receiver}.{last}() does HTTP I/O"
+    if last in _REST_VERBS and chain[:-1] and \
+            _is_rest_receiver(chain[-2].lower()):
+        return f"{receiver}.{last}() is an apiserver round-trip"
+    if last == "wait" and receiver and receiver != held_lock_text:
+        return (f"{receiver}.wait() sleeps while the lock is held "
+                "(only waiting on the held lock itself releases it)")
+    if last == "join" and "thread" in rlow:
+        return f"{receiver}.join() blocks on another thread"
+    return None
+
+
+class LockHeldAcrossIOChecker(Checker):
+    name = "lock-held-across-io"
+    description = ("blocking I/O (REST verbs, sockets, subprocess, sleep, "
+                   "device sync) inside a `with <lock>:` body")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                lock_expr = item.context_expr
+                # `with lock.acquire():` style — unwrap call AND the
+                # .acquire so the receiver is what the name heuristic sees
+                if isinstance(lock_expr, ast.Call):
+                    lock_expr = lock_expr.func
+                    if isinstance(lock_expr, ast.Attribute) and \
+                            lock_expr.attr in ("acquire", "acquire_read",
+                                               "acquire_write"):
+                        lock_expr = lock_expr.value
+                if not is_lock_expr(lock_expr):
+                    continue
+                lock_text = chain_text(lock_expr)
+                for inner in self._body_nodes(node):
+                    if not isinstance(inner, ast.Call):
+                        continue
+                    reason = blocking_reason(inner, lock_text)
+                    if reason:
+                        yield self.finding(
+                            ctx, inner,
+                            f"{reason} while holding {lock_text or 'a lock'}"
+                            " — move the blocking call outside the lock")
+
+    @staticmethod
+    def _body_nodes(with_node):
+        for stmt in with_node.body:
+            yield stmt
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                yield from walk_same_scope(stmt)

@@ -1,0 +1,46 @@
+"""Text and JSON reporters for kube-verify findings."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, TextIO
+
+from kubernetes_tpu.analysis.core import Finding
+
+
+def render_text(results: Dict[str, List[Finding]], out: TextIO,
+                verbose_baselined: bool = False) -> None:
+    new, baselined = results["new"], results["baselined"]
+    for f in sorted(new, key=lambda f: (f.path, f.line, f.col)):
+        out.write(f"{f.path}:{f.line}:{f.col + 1}: [{f.check}] "
+                  f"{f.message}\n")
+        if f.snippet:
+            out.write(f"    {f.snippet}\n")
+    if verbose_baselined:
+        for f in sorted(baselined, key=lambda f: (f.path, f.line)):
+            out.write(f"{f.path}:{f.line}:{f.col + 1}: [baselined:"
+                      f"{f.check}] {f.message}\n")
+    by_check: Dict[str, int] = {}
+    for f in new:
+        by_check[f.check] = by_check.get(f.check, 0) + 1
+    summary = ", ".join(f"{k}={v}" for k, v in sorted(by_check.items()))
+    out.write(f"kube-verify: {len(new)} finding(s)"
+              f"{' (' + summary + ')' if summary else ''}, "
+              f"{len(baselined)} baselined\n")
+
+
+def render_json(results: Dict[str, List[Finding]], out: TextIO) -> None:
+    payload = {
+        "findings": [f.to_dict() for f in
+                     sorted(results["new"],
+                            key=lambda f: (f.path, f.line, f.col))],
+        "baselined": [f.to_dict() for f in
+                      sorted(results["baselined"],
+                             key=lambda f: (f.path, f.line, f.col))],
+        "summary": {
+            "new": len(results["new"]),
+            "baselined": len(results["baselined"]),
+        },
+    }
+    json.dump(payload, out, indent=2)
+    out.write("\n")

@@ -1,0 +1,333 @@
+"""Runtime race detection for tests — the ``go test -race`` stand-in.
+
+Two instruments, both switched on by tests/conftest.py so the whole tier-1
+suite runs under them:
+
+**Lock-order tracker.** ``install_lock_order_tracker()`` patches
+``threading.Lock``/``threading.RLock`` with factories that wrap locks
+*created from kubernetes_tpu code* (caller-module check at creation time —
+stdlib and pytest internals keep real locks). Each wrapped lock belongs to
+an order class keyed by its creation site (file:line — all per-pod locks
+minted by one line are one class, like lockdep). Acquiring B while holding
+A records the edge A→B in a global acquisition graph; an edge that closes
+a cycle (the classic A→B vs B→A inversion) records a LockOrderViolation.
+Violations are *recorded*, not raised — a detector that crashes arbitrary
+victim threads hides the report; tests/conftest fails the responsible test
+from its teardown hook instead.
+
+**Checked informer store.** ``enable_checked_store()`` makes every
+``ThreadSafeStore`` fingerprint objects on insert (stable serialization of
+the dataclass) and re-fingerprint on read; a mismatch means some reader
+mutated the shared cache object in place — the runtime complement of the
+``informer-cache-mutation`` static check, and it sees through helper-call
+indirection the AST pass cannot. Reads are verified in full for small
+stores and sampled above ``VERIFY_FULL_LIMIT`` so the 30k-pod scale test
+keeps its throughput SLO.
+
+Both report into a module-global violation list: ``drain_violations()``
+returns-and-clears it (the conftest teardown hook asserts it is empty
+after every test; seeded-violation tests drain it themselves).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_real_Lock = threading.Lock
+_real_RLock = threading.RLock
+
+# -- shared violation sink -----------------------------------------------------
+
+_violations: List[str] = []
+_violations_lock = _real_Lock()
+
+
+def record_violation(message: str) -> None:
+    with _violations_lock:
+        _violations.append(message)
+
+
+def drain_violations() -> List[str]:
+    """Return and clear all recorded race violations."""
+    with _violations_lock:
+        out = list(_violations)
+        _violations.clear()
+    return out
+
+
+def peek_violations() -> List[str]:
+    with _violations_lock:
+        return list(_violations)
+
+
+# -- lock-order tracking -------------------------------------------------------
+
+class LockOrderTracker:
+    """Acquisition-order graph over lock classes (creation sites)."""
+
+    def __init__(self):
+        self._lock = _real_Lock()
+        self._graph: Dict[str, Set[str]] = {}   # site -> sites acquired under
+        self._edges: Set[Tuple[str, str]] = set()
+        self._reported: Set[Tuple[str, str]] = set()
+        self._held = threading.local()          # [(site, lock_id, count)]
+        self.violations: List[str] = []
+
+    def _held_list(self) -> list:
+        held = getattr(self._held, "stack", None)
+        if held is None:
+            held = self._held.stack = []
+        return held
+
+    def note_acquired(self, site: str, lock_id: int) -> None:
+        held = self._held_list()
+        for entry in held:
+            if entry[1] == lock_id:     # RLock re-entry: no new ordering
+                entry[2] += 1
+                return
+        new_edges = [(h_site, site) for h_site, _, _ in held
+                     if h_site != site
+                     and (h_site, site) not in self._edges]
+        held.append([site, lock_id, 1])
+        if not new_edges:
+            return
+        with self._lock:
+            for edge in new_edges:
+                if edge in self._edges:
+                    continue
+                self._edges.add(edge)
+                self._graph.setdefault(edge[0], set()).add(edge[1])
+                cycle = self._find_cycle(edge)
+                if cycle:
+                    self._report(cycle)
+
+    def note_released(self, lock_id: int) -> None:
+        held = self._held_list()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == lock_id:
+                held[i][2] -= 1
+                if held[i][2] <= 0:
+                    del held[i]
+                return
+
+    def _find_cycle(self, new_edge: Tuple[str, str]) -> Optional[List[str]]:
+        """Adding src→dst closes a cycle iff dst already reaches src."""
+        src, dst = new_edge
+        parent = {dst: None}
+        stack = [dst]
+        while stack:
+            node = stack.pop()
+            for nxt in self._graph.get(node, ()):
+                if nxt == src:
+                    # cycle: src -> dst -> ... -> node -> src
+                    path = [node]
+                    while parent[path[-1]] is not None:
+                        path.append(parent[path[-1]])
+                    return [src] + list(reversed(path)) + [src]
+                if nxt not in parent:
+                    parent[nxt] = node
+                    stack.append(nxt)
+        return None
+
+    def _report(self, cycle: List[str]) -> None:
+        key = (cycle[0], cycle[1])
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        msg = ("lock-order inversion (potential deadlock): "
+               + " -> ".join(cycle)
+               + f" [thread {threading.current_thread().name}]")
+        self.violations.append(msg)
+        record_violation(msg)
+
+
+class InstrumentedLock:
+    """Wraps a real Lock/RLock; reports acquire/release to the tracker.
+    Exposes the Condition protocol (_release_save etc.) by delegating to
+    the real lock — during Condition.wait the thread is blocked, so the
+    held-set staying 'as if held' is exactly right."""
+
+    def __init__(self, real, site: str, tracker: LockOrderTracker):
+        self._real = real
+        self._site = site
+        self._tracker = tracker
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._tracker.note_acquired(self._site, id(self))
+        return got
+
+    def release(self):
+        self._tracker.note_released(id(self))
+        self._real.release()
+
+    def locked(self):
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition(lock) protocol — present only on RLock; getattr keeps the
+    # plain-Lock wrapper working (Condition falls back to acquire/release)
+    def __getattr__(self, name):
+        if name in ("_release_save", "_acquire_restore", "_is_owned",
+                    "_at_fork_reinit"):
+            return getattr(self._real, name)
+        raise AttributeError(name)
+
+    def __repr__(self):
+        return f"<InstrumentedLock site={self._site} {self._real!r}>"
+
+
+_installed_tracker: Optional[LockOrderTracker] = None
+
+
+def install_lock_order_tracker(module_prefix: str = "kubernetes_tpu",
+                               ) -> LockOrderTracker:
+    """Patch threading.Lock/RLock to mint instrumented locks for code in
+    `module_prefix`. Idempotent; returns the active tracker."""
+    global _installed_tracker
+    if _installed_tracker is not None:
+        return _installed_tracker
+    tracker = LockOrderTracker()
+
+    def _site_of(frame) -> str:
+        return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+    def _wants_instrumentation(frame) -> bool:
+        mod = frame.f_globals.get("__name__", "")
+        return mod == module_prefix or mod.startswith(module_prefix + ".")
+
+    def make_lock():
+        frame = sys._getframe(1)
+        real = _real_Lock()
+        if _wants_instrumentation(frame):
+            return InstrumentedLock(real, _site_of(frame), tracker)
+        return real
+
+    def make_rlock():
+        frame = sys._getframe(1)
+        real = _real_RLock()
+        if _wants_instrumentation(frame):
+            return InstrumentedLock(real, _site_of(frame), tracker)
+        return real
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    _installed_tracker = tracker
+    return tracker
+
+
+def uninstall_lock_order_tracker() -> None:
+    global _installed_tracker
+    threading.Lock = _real_Lock
+    threading.RLock = _real_RLock
+    _installed_tracker = None
+
+
+# -- checked informer store ----------------------------------------------------
+
+# above this many tracked objects, reads verify a deterministic sample so
+# scale tests (30k pods) keep their throughput SLOs
+VERIFY_FULL_LIMIT = 1024
+SAMPLE_STRIDE = 64
+
+
+def fingerprint(obj) -> str:
+    """Stable content hash of an API object (dataclass) or plain value."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        from kubernetes_tpu.api.serialization import to_dict
+        payload = to_dict(obj)
+    else:
+        payload = obj
+    try:
+        raw = json.dumps(payload, sort_keys=True, default=repr)
+    except (TypeError, ValueError):
+        raw = repr(payload)
+    return hashlib.sha1(raw.encode()).hexdigest()
+
+
+class StoreChecker:
+    """Per-store mutation detector: fingerprint on write, verify on read.
+    Reports each mutated key once (a hot loop re-reading the same mutated
+    pod must not flood the report)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._fp: Dict[str, str] = {}
+        self._flagged: Set[str] = set()
+        self._lock = _real_Lock()
+
+    def on_write(self, key: str, obj) -> None:
+        with self._lock:
+            self._fp[key] = fingerprint(obj)
+            self._flagged.discard(key)
+
+    def on_delete(self, key: str) -> None:
+        with self._lock:
+            self._fp.pop(key, None)
+            self._flagged.discard(key)
+
+    def on_replace(self, items: Dict[str, object]) -> None:
+        with self._lock:
+            self._fp = {k: fingerprint(v) for k, v in items.items()}
+            self._flagged = set()
+
+    def verify(self, key: str, obj) -> None:
+        with self._lock:
+            want = self._fp.get(key)
+            if want is None or key in self._flagged:
+                return
+            if fingerprint(obj) != want:
+                self._flagged.add(key)
+                msg = (f"informer-cache mutation detected: object {key!r} "
+                       f"in store {self.name or id(self)} changed while "
+                       "cached — some reader mutated it in place instead "
+                       "of deep_copy()ing")
+                record_violation(msg)
+
+    def verify_many(self, items) -> None:
+        """items: iterable of (key, obj). Samples above VERIFY_FULL_LIMIT."""
+        with self._lock:
+            tracked = len(self._fp)
+        if tracked <= VERIFY_FULL_LIMIT:
+            for key, obj in items:
+                self.verify(key, obj)
+        else:
+            for i, (key, obj) in enumerate(items):
+                if i % SAMPLE_STRIDE == 0:
+                    self.verify(key, obj)
+
+
+_checked_store_enabled = False
+
+
+def enable_checked_store() -> None:
+    global _checked_store_enabled
+    _checked_store_enabled = True
+
+
+def disable_checked_store() -> None:
+    global _checked_store_enabled
+    _checked_store_enabled = False
+
+
+def checked_store_enabled() -> bool:
+    return _checked_store_enabled
+
+
+def new_store_checker(name: str = "") -> Optional[StoreChecker]:
+    """Factory used by client.cache.ThreadSafeStore — None when the mode is
+    off, so the store's fast path stays branch-on-None cheap."""
+    return StoreChecker(name) if _checked_store_enabled else None

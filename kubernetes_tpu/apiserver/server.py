@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import re
 import socket
 import threading
@@ -283,7 +284,9 @@ class _Handler(BaseHTTPRequestHandler):
                     try:
                         self._send_status(500, "InternalError",
                                           f"{type(e).__name__}: {e}")
-                    except Exception:
+                    except (OSError, ValueError):
+                        # client hung up / headers already sent — the
+                        # original crash is already on stderr above
                         pass
         finally:
             if sem is not None:
@@ -555,7 +558,11 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     release(attrs)
                 except Exception:
-                    pass  # best-effort; periodic recalc is the backstop
+                    # best-effort; periodic recalc is the backstop — but a
+                    # plugin that can't release quota leaks it until then
+                    logging.getLogger("apiserver").exception(
+                        "admission release_create failed for %s/%s",
+                        ns, resource)
 
     def _check_body_matches_url(self, obj, name: str, ns: str):
         """The reference apiserver rejects name/namespace mismatches between

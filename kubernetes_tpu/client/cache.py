@@ -12,6 +12,7 @@ import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from kubernetes_tpu.analysis import runtime as _race
 from kubernetes_tpu.api import types as api
 
 
@@ -27,17 +28,24 @@ class ThreadSafeStore:
     """Keyed object store with optional named indexes
     (reference thread_safe_store.go + Indexer)."""
 
-    def __init__(self, indexers: Optional[Dict[str, Callable]] = None):
+    def __init__(self, indexers: Optional[Dict[str, Callable]] = None,
+                 name: str = ""):
         self._lock = threading.RLock()
         self._items: Dict[str, object] = {}
         self._indexers = indexers or {}
         self._indices: Dict[str, Dict[str, set]] = {n: {} for n in self._indexers}
+        # race-detector mode (analysis/runtime.py, enabled by conftest):
+        # fingerprint on write, verify on read — catches readers mutating
+        # shared cache objects in place. None in production: one branch.
+        self._checker = _race.new_store_checker(name)
 
     def add(self, key: str, obj):
         with self._lock:
             old = self._items.get(key)
             self._items[key] = obj
             self._update_indices(key, old, obj)
+            if self._checker:
+                self._checker.on_write(key, obj)
 
     update = add
 
@@ -46,13 +54,20 @@ class ThreadSafeStore:
             old = self._items.pop(key, None)
             if old is not None:
                 self._update_indices(key, old, None)
+            if self._checker:
+                self._checker.on_delete(key)
 
     def get(self, key: str):
         with self._lock:
-            return self._items.get(key)
+            obj = self._items.get(key)
+            if self._checker and obj is not None:
+                self._checker.verify(key, obj)
+            return obj
 
     def list(self) -> list:
         with self._lock:
+            if self._checker:
+                self._checker.verify_many(list(self._items.items()))
             return list(self._items.values())
 
     def list_keys(self) -> list:
@@ -65,11 +80,16 @@ class ThreadSafeStore:
             self._indices = {n: {} for n in self._indexers}
             for key, obj in self._items.items():
                 self._update_indices(key, None, obj)
+            if self._checker:
+                self._checker.on_replace(self._items)
 
     def by_index(self, index_name: str, value: str) -> list:
         with self._lock:
             keys = self._indices.get(index_name, {}).get(value, ())
-            return [self._items[k] for k in keys if k in self._items]
+            pairs = [(k, self._items[k]) for k in keys if k in self._items]
+            if self._checker:
+                self._checker.verify_many(pairs)
+            return [v for _, v in pairs]
 
     def __len__(self):
         with self._lock:

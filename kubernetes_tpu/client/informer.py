@@ -29,9 +29,9 @@ class Informer:
     def __init__(self, lw: ListWatch, key_func: Callable = meta_namespace_key,
                  indexers: Optional[Dict[str, Callable]] = None,
                  relist_backoff: float = 1.0):
-        self.store = ThreadSafeStore(indexers)
-        self.key = key_func
         self.resource = getattr(lw, "resource", "")
+        self.store = ThreadSafeStore(indexers, name=self.resource)
+        self.key = key_func
         self._handlers: List[dict] = []
         self._events: "queue.Queue" = queue.Queue()
         self._lag_stamped = 0.0

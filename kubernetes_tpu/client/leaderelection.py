@@ -38,7 +38,13 @@ class LeaderElector:
     def __init__(self, client: RESTClient, config: LeaderElectionConfig,
                  on_started_leading: Callable[[], None],
                  on_stopped_leading: Optional[Callable[[], None]] = None,
-                 clock=time.time):
+                 clock=time.monotonic):
+        # `clock` drives lease expiry and renew deadlines — durations
+        # relative to our own observations, so it must not jump with NTP
+        # steps (the reference measures against observedTime the same way,
+        # leaderelection.go:81). The acquire/renew TIMESTAMPS serialized
+        # into the lease record stay wall-clock: they are cross-process
+        # debug data, never compared against this clock.
         self.client = client
         self.cfg = config
         self.on_started = on_started_leading
@@ -58,11 +64,12 @@ class LeaderElector:
 
     def try_acquire_or_renew(self) -> bool:
         now = self._clock()
+        wall_now = time.time()  # serialized into the record; never compared
         record = {
             "holderIdentity": self.cfg.identity,
             "leaseDurationSeconds": int(self.cfg.lease_duration),
-            "acquireTime": now,
-            "renewTime": now,
+            "acquireTime": wall_now,
+            "renewTime": wall_now,
         }
         try:
             ep = self.client.get("endpoints", self.cfg.lock_name,
@@ -91,7 +98,7 @@ class LeaderElector:
             if held_by_other and lease_valid:
                 return False  # someone else holds an unexpired lease
             if not held_by_other:
-                record["acquireTime"] = old.get("acquireTime", now)
+                record["acquireTime"] = old.get("acquireTime", wall_now)
         ep.metadata.annotations = dict(ann)
         ep.metadata.annotations[LEADER_ANNOTATION] = json.dumps(record)
         try:

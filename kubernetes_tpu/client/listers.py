@@ -4,6 +4,15 @@ Parity target: reference pkg/client/cache/listers.go — StoreToPodLister,
 StoreToNodeLister (with the readiness filtering the scheduler applies,
 factory.go:332,434-454), StoreToServiceLister/StoreToControllerLister/
 StoreToReplicaSetLister with GetPodX helpers used by the spreading priority.
+
+Aliasing policy: informer stores hand out the SHARED cached objects; a
+consumer mutating one corrupts every other reader (the bug class the
+``informer-cache-mutation`` checker and the checked-store test mode exist
+for). Listers therefore deep-copy on read by default — consumers own what
+they're handed. Hot paths that only READ (the scheduler's per-decision
+listings over thousands of nodes/pods) opt out with ``copy_on_read=False``
+and inherit the read-only contract; the checked store still polices them
+at test time.
 """
 
 from __future__ import annotations
@@ -12,37 +21,49 @@ from typing import Callable, List, Optional
 
 from kubernetes_tpu.api import labels as labelsel
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.serialization import deep_copy
 from kubernetes_tpu.client.cache import ThreadSafeStore
 
 
-class PodLister:
-    def __init__(self, store: ThreadSafeStore):
+class _CopyingLister:
+    def __init__(self, store: ThreadSafeStore, copy_on_read: bool = True):
         self.store = store
+        self.copy_on_read = copy_on_read
 
+    def _out_list(self, objs: list) -> list:
+        if not self.copy_on_read:
+            return objs
+        return [deep_copy(o) for o in objs]
+
+
+class PodLister(_CopyingLister):
     def list(self, selector: Optional[labelsel.Selector] = None) -> List[api.Pod]:
         pods = self.store.list()
-        if selector is None or selector.empty():
-            return pods
-        return [p for p in pods
-                if selector.matches((p.metadata.labels or {}) if p.metadata else {})]
+        if selector is not None and not selector.empty():
+            pods = [p for p in pods
+                    if selector.matches((p.metadata.labels or {})
+                                        if p.metadata else {})]
+        return self._out_list(pods)
 
     def by_node(self, node_name: str) -> List[api.Pod]:
-        return self.store.by_index("node", node_name)
+        return self._out_list(self.store.by_index("node", node_name))
 
 
-class NodeLister:
+class NodeLister(_CopyingLister):
     def __init__(self, store: ThreadSafeStore,
-                 predicate: Optional[Callable[[api.Node], bool]] = None):
-        self.store = store
+                 predicate: Optional[Callable[[api.Node], bool]] = None,
+                 copy_on_read: bool = True):
+        super().__init__(store, copy_on_read)
         self.predicate = predicate or node_is_ready
 
     def list(self) -> List[api.Node]:
         """Ready nodes only — the scheduler never sees NotReady nodes
         (reference getNodeConditionPredicate, factory.go:434-454)."""
-        return [n for n in self.store.list() if self.predicate(n)]
+        return self._out_list(
+            [n for n in self.store.list() if self.predicate(n)])
 
     def list_all(self) -> List[api.Node]:
-        return self.store.list()
+        return self._out_list(self.store.list())
 
 
 def node_is_ready(node: api.Node) -> bool:
@@ -60,12 +81,9 @@ def node_is_ready(node: api.Node) -> bool:
     return ready
 
 
-class ServiceLister:
-    def __init__(self, store: ThreadSafeStore):
-        self.store = store
-
+class ServiceLister(_CopyingLister):
     def list(self) -> List[api.Service]:
-        return self.store.list()
+        return self._out_list(self.store.list())
 
     def get_pod_services(self, pod: api.Pod) -> List[api.Service]:
         """Services whose selector matches the pod (same namespace) —
@@ -78,15 +96,12 @@ class ServiceLister:
             sel = svc.spec.selector if svc.spec else None
             if sel and labelsel.selector_from_map(sel).matches(pod_labels):
                 out.append(svc)
-        return out
+        return self._out_list(out)
 
 
-class ControllerLister:
-    def __init__(self, store: ThreadSafeStore):
-        self.store = store
-
+class ControllerLister(_CopyingLister):
     def list(self) -> List[api.ReplicationController]:
-        return self.store.list()
+        return self._out_list(self.store.list())
 
     def get_pod_controllers(self, pod: api.Pod) -> List[api.ReplicationController]:
         out = []
@@ -97,15 +112,12 @@ class ControllerLister:
             sel = rc.spec.selector if rc.spec else None
             if sel and labelsel.selector_from_map(sel).matches(pod_labels):
                 out.append(rc)
-        return out
+        return self._out_list(out)
 
 
-class ReplicaSetLister:
-    def __init__(self, store: ThreadSafeStore):
-        self.store = store
-
+class ReplicaSetLister(_CopyingLister):
     def list(self) -> List[api.ReplicaSet]:
-        return self.store.list()
+        return self._out_list(self.store.list())
 
     def get_pod_replica_sets(self, pod: api.Pod) -> List[api.ReplicaSet]:
         out = []
@@ -116,4 +128,4 @@ class ReplicaSetLister:
             sel = rs.spec.selector if rs.spec else None
             if sel is not None and labelsel.selector_from_label_selector(sel).matches(pod_labels):
                 out.append(rs)
-        return out
+        return self._out_list(out)

@@ -12,6 +12,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 from kubernetes_tpu.utils.workqueue import RateLimitingQueue
 
 log = logging.getLogger("controller")
@@ -73,10 +74,27 @@ class Controller:
                 self.sync(key)
                 self.queue.forget(key)
             except Exception as e:
-                log.info("%s: sync %s failed: %s; requeueing", self.name, key, e)
+                # a sync loop that fails quietly for hours is the bug class
+                # the swallowed-exception checker exists for: every failure
+                # is logged at warning WITH the error, counted, and offered
+                # to the subclass's recorder before the rate-limited requeue
+                log.warning("%s: sync %s failed: %s: %s; requeueing",
+                            self.name, key, type(e).__name__, e)
+                METRICS.inc("controller_sync_errors_total",
+                            controller=self.name)
+                try:
+                    self.on_sync_error(key, e)
+                except Exception:
+                    log.exception("%s: on_sync_error hook failed", self.name)
                 self.queue.add_rate_limited(key)
             finally:
                 self.queue.done(key)
+
+    def on_sync_error(self, key: str, err: Exception) -> None:
+        """Subclass hook: controllers with an EventRecorder post a Warning
+        Event for the object behind `key` here (utils/events.py handles
+        dedup/aggregation, so a crash-looping sync can't melt the
+        apiserver). Default: counted + logged by the worker, nothing more."""
 
     def stop(self):
         self._stop.set()

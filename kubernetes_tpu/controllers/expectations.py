@@ -16,7 +16,7 @@ EXPECTATIONS_TIMEOUT = 5 * 60.0
 
 
 class ControllerExpectations:
-    def __init__(self, clock=time.time):
+    def __init__(self, clock=time.monotonic):
         self._clock = clock
         self._lock = threading.Lock()
         # key -> [adds_pending, dels_pending, set_time]

@@ -98,6 +98,9 @@ class JobController(Controller):
             limit = job.spec.active_deadline_seconds if job.spec else None
             started = parse_iso(start_time)
             if limit is not None and started is not None:
+                # wall vs the SERIALIZED job start timestamp — monotonic has
+                # no epoch to compare against it
+                # kube-verify: disable-next-line=monotonic-duration
                 self.enqueue_after(key, max(0.0, started + limit - time.time()))
 
         condition = None
@@ -134,6 +137,8 @@ class JobController(Controller):
         if limit is None:
             return False
         started = parse_iso(start_time)
+        # wall vs serialized start timestamp (see _past_deadline caller)
+        # kube-verify: disable-next-line=monotonic-duration
         return started is not None and (time.time() - started) >= limit
 
     def _manage(self, key, job, active: list, succeeded: int) -> list:

@@ -30,7 +30,7 @@ class NodeController:
                  grace_period: float = 40.0,
                  pod_eviction_timeout: float = 60.0,
                  eviction_qps: float = 0.1,
-                 clock=time.time):
+                 clock=time.monotonic):
         self.client = client
         self.monitor_period = monitor_period
         self.grace_period = grace_period

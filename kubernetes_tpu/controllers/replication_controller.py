@@ -51,6 +51,15 @@ class ReplicationManager(Controller):
         self.expectations.delete_expectations(_key(rc))
         self.enqueue(_key(rc))
 
+    def on_sync_error(self, key: str, err: Exception) -> None:
+        """Failed syncs surface as Warning Events on the RC (the base
+        worker already logged + counted them) — the correlator dedups a
+        crash-looping sync into one climbing count."""
+        rc = self.rc_informer.store.get(key)
+        if rc is not None:
+            self.recorder.event(rc, "Warning", "FailedSync",
+                                f"Error syncing: {type(err).__name__}: {err}")
+
     def _pod_added(self, pod: api.Pod):
         for rc in self._controllers_for(pod):
             self.expectations.creation_observed(_key(rc))

@@ -36,7 +36,11 @@ class ScheduledJobController(Controller):
     name = "scheduledjob"
 
     def __init__(self, client: RESTClient, workers: int = 1,
-                 sync_seconds: float = 10.0, clock=time.time):
+                 sync_seconds: float = 10.0,
+                 # cron schedules fire at WALL times ("0 3 * * *" means 3am,
+                 # not 3h-of-monotonic)
+                 # kube-verify: disable-next-line=monotonic-duration
+                 clock=time.time):
         super().__init__(workers)
         self.client = client
         self.sync_seconds = sync_seconds

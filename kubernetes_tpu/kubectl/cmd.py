@@ -9,6 +9,7 @@ api-versions, cluster-info."""
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import sys
 import time
@@ -298,7 +299,7 @@ def cmd_rollout(args) -> int:
             raise CommandError(f"rollout is not supported on {resource}")
         ns = _ns(args)
         if sub == "status":
-            deadline = time.time() + args.timeout
+            deadline = time.monotonic() + args.timeout
             while True:
                 d = client.get(resource, name, ns)
                 want = (d.spec.replicas or 0) if d.spec else 0
@@ -307,7 +308,7 @@ def cmd_rollout(args) -> int:
                         st.available_replicas >= want:
                     print(f"deployment \"{name}\" successfully rolled out")
                     break
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise CommandError(
                         f"deployment \"{name}\" not rolled out: "
                         f"{st.updated_replicas} updated, "
@@ -524,9 +525,16 @@ def cmd_expose(args) -> int:
 def cmd_version(args) -> int:
     print(f"Client Version: {VERSION}")
     try:
-        _client(args).request("GET", "/healthz")
+        try:
+            _client(args).request("GET", "/healthz")
+        except ValueError:
+            pass  # /healthz answers plain "ok", not JSON — reachable is all
+            # that matters (the old blanket except hid this, so a healthy
+            # server never printed its version)
         print(f"Server Version: {VERSION}")
-    except Exception:
+    except (ApiError, OSError, http.client.HTTPException):
+        # unreachable or misbehaving server (RESTClient re-raises
+        # HTTPException after retries): client-only output, never a crash
         pass
     return 0
 

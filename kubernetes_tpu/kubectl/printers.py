@@ -37,6 +37,8 @@ def age_of(obj) -> str:
     ts = parse_iso(obj.metadata.creation_timestamp if obj.metadata else None)
     if ts is None:
         return "<unknown>"
+    # AGE = wall now minus the serialized creationTimestamp
+    # kube-verify: disable-next-line=monotonic-duration
     return human_duration(time.time() - ts)
 
 

@@ -235,8 +235,12 @@ class Kubelet:
                 # no prior status.start_time: a kubelet restart re-syncing
                 # long-running pods must not record pod AGE as startup
                 # latency and poison the histogram's tail
+                # wall vs the serialized creationTimestamp — monotonic has
+                # no epoch to compare against it
+                # kube-verify: disable-next-line=monotonic-duration
+                startup = max(time.time() - created, 0.0)
                 METRICS.observe("kubelet_pod_startup_latency_seconds",
-                                max(time.time() - created, 0.0))
+                                startup)
             # pods with readiness probes start unready until the first
             # success; afterwards the probe loop owns this bit
             has_readiness = any(c.readiness_probe

@@ -337,6 +337,9 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
     alloc = t["alloc"]                      # [N, 4]
     N = alloc.shape[0]
     G = t["group_counts0"].shape[1]
+    # n_zones arrives as a STATIC python int (jit static_argnames) packed
+    # into t; the isinstance guard keeps the traced-dict path working
+    # kube-verify: disable-next-line=host-sync-in-kernel
     Z = int(t["n_zones"]) if isinstance(t["n_zones"], int) else t["n_zones"]
     idx_n = jnp.arange(N, dtype=jnp.int32)
 

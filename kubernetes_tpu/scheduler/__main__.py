@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import signal
 import sys
 import threading
@@ -68,8 +69,12 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, client):
         )
         try:
             enable_persistent_compilation_cache()
-        except Exception:
-            pass  # cache is an optimization, never a startup blocker
+        except Exception as e:
+            # the cache is an optimization, never a startup blocker — but a
+            # cold compile on every restart is worth a visible warning
+            logging.getLogger("scheduler").warning(
+                "persistent compilation cache unavailable "
+                "(every restart pays a cold XLA compile): %s", e)
         sched = factory.create_batch_from_provider(
             cfg.algorithm_provider, batch_size=cfg.batch_size)
     else:

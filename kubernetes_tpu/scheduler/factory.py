@@ -107,11 +107,20 @@ class ConfigFactory:
             self.pv_informer, self.pvc_informer,
         ]
 
-        self.pod_lister = PodLister(self.assigned_informer.store)
-        self.node_lister = NodeLister(self.node_informer.store)
-        self.service_lister = ServiceLister(self.service_informer.store)
-        self.controller_lister = ControllerLister(self.rc_informer.store)
-        self.replicaset_lister = ReplicaSetLister(self.rs_informer.store)
+        # copy_on_read=False: these run on the per-decision hot path (a
+        # 30k-pod solve lists thousands of objects) and the scheduler only
+        # READS them — deep-copies before any mutation (_with_node). The
+        # checked-store test mode enforces that contract at test time.
+        self.pod_lister = PodLister(self.assigned_informer.store,
+                                    copy_on_read=False)
+        self.node_lister = NodeLister(self.node_informer.store,
+                                      copy_on_read=False)
+        self.service_lister = ServiceLister(self.service_informer.store,
+                                            copy_on_read=False)
+        self.controller_lister = ControllerLister(self.rc_informer.store,
+                                                  copy_on_read=False)
+        self.replicaset_lister = ReplicaSetLister(self.rs_informer.store,
+                                                  copy_on_read=False)
 
         self.plugin_args = PluginArgs(
             pod_lister=self.pod_lister,
@@ -145,6 +154,8 @@ class ConfigFactory:
                     if len(self._delivered) > 200_000:
                         self._delivered.clear()
                     self._delivered.add(key)
+                    # wall vs the serialized creationTimestamp
+                    # kube-verify: disable-next-line=monotonic-duration
                     lag = max(time.time() - created, 0.0)
                     METRICS.observe("scheduler_informer_delivery_seconds", lag)
                     sp.attrs["informer_delivery_seconds"] = round(lag, 3)

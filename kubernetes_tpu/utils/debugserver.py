@@ -11,6 +11,7 @@ consumed by nothing.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from dataclasses import asdict, is_dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -36,7 +37,10 @@ def debug_route(path: str, healthz: Callable[[], bool],
         try:
             ok = healthz()
         except Exception:
-            pass
+            # a crashing health callback IS unhealthy, but the probe reply
+            # must not hide why
+            logging.getLogger("debugserver").exception(
+                "healthz callback raised; reporting unhealthy")
         return (200 if ok else 500, b"ok" if ok else b"unhealthy",
                 "text/plain")
     if path == "/metrics":

@@ -9,6 +9,7 @@ shares this dance here instead of hand-copying it.
 """
 
 import hashlib
+import logging
 import os
 import re
 
@@ -46,8 +47,11 @@ def machine_fingerprint(include_device: bool = False) -> str:
             d = jax.devices()[0]
             device = getattr(d, "device_kind", "") or d.platform
             feats.append(device)
-    except Exception:
-        pass
+    except Exception as e:
+        # fingerprint degrades to host features only — say which import or
+        # device probe failed so a wrong-platform cache key is explainable
+        logging.getLogger("platform").debug(
+            "machine fingerprint: jax features unavailable: %s", e)
     tag = _label_safe("-".join(
         t for t in (_p.machine(),
                     os.environ.get("JAX_PLATFORMS") or "auto", device) if t))

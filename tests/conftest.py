@@ -8,6 +8,20 @@ force_cpu() does the full dance — env vars alone are NOT enough because the
 axon sitecustomize force-registers the TPU platform at interpreter startup
 and its jax.config.update beats JAX_PLATFORMS; without the config update +
 clear_backends the suite hangs trying to grab the chip.
+
+The whole suite also runs under the kube-verify runtime race detectors
+(kubernetes_tpu/analysis/runtime.py — our `go test -race` stand-in):
+
+- every lock created by kubernetes_tpu code is order-tracked; an A→B/B→A
+  acquisition inversion anywhere in the run is recorded;
+- every informer ThreadSafeStore fingerprints objects on write and
+  verifies on read — in-place mutation of a shared cache object is
+  recorded.
+
+Recorded violations fail the test that triggered them (teardown hook
+below). Tests that deliberately seed violations drain_violations()
+themselves. Set KTPU_NO_RACE_DETECT=1 to switch both off (e.g. when
+bisecting whether the instrumentation itself perturbs a timing test).
 """
 
 import os
@@ -15,6 +29,28 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# install BEFORE any kubernetes_tpu module mints its locks — analysis.runtime
+# itself only touches stdlib at import time
+from kubernetes_tpu.analysis import runtime as _race  # noqa: E402
+
+_RACE_DETECT = os.environ.get("KTPU_NO_RACE_DETECT", "") != "1"
+if _RACE_DETECT:
+    _race.install_lock_order_tracker()
+    _race.enable_checked_store()
+
 from kubernetes_tpu.utils.platform import force_cpu  # noqa: E402
 
 force_cpu(device_count=8)
+
+
+def pytest_runtest_teardown(item, nextitem):
+    """Fail the responsible test on any recorded race violation — raising
+    inside a victim thread would vanish into a log; failing the test makes
+    the inversion/mutation a red X with the full report attached."""
+    if not _RACE_DETECT:
+        return
+    violations = _race.drain_violations()
+    if violations:
+        raise AssertionError(
+            "kube-verify runtime race detector recorded violation(s) "
+            f"during {item.nodeid}:\n  " + "\n  ".join(violations))

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# kube-verify: the repo's pre-merge battery (the hack/verify-* analogue).
+#
+#   1. static analysis  — python -m kubernetes_tpu.analysis over the package
+#                         (zero non-baselined findings or it fails)
+#   2. tier-1 tests     — the full 'not slow' suite, which tests/conftest.py
+#                         runs under the runtime race detectors (lock-order
+#                         tracker + checked informer store); any recorded
+#                         inversion or cache mutation fails the test that
+#                         triggered it
+#
+# Usage: tools/verify.sh [--static-only|--tests-only]
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+run_static=1
+run_tests=1
+case "${1:-}" in
+  --static-only) run_tests=0 ;;
+  --tests-only)  run_static=0 ;;
+  "") ;;
+  *) echo "usage: tools/verify.sh [--static-only|--tests-only]" >&2; exit 2 ;;
+esac
+
+if [ "$run_static" = 1 ]; then
+  echo "== kube-verify static analysis =="
+  python -m kubernetes_tpu.analysis kubernetes_tpu/
+fi
+
+if [ "$run_tests" = 1 ]; then
+  echo "== tier-1 tests (race detectors on) =="
+  JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+fi
+
+echo "verify: OK"
