@@ -74,7 +74,8 @@ class KubeletServer:
             def do_GET(self):
                 url = urlparse(self.path)
                 q = parse_qs(url.query, keep_blank_values=True)
-                hit = debug_route(url.path, outer.healthz, outer.configz)
+                # full path incl. query: /profilez/start?dir=... needs it
+                hit = debug_route(self.path, outer.healthz, outer.configz)
                 if hit is not None:
                     return self._send(*hit[:2], hit[2])
                 if url.path == "/pods":

@@ -24,6 +24,7 @@ from kubernetes_tpu.client import Informer, ListWatch, RESTClient
 from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
 from kubernetes_tpu.kubelet.runtime import FakeCadvisor
 from kubernetes_tpu.proxy import FakeIptables, Proxier
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 log = logging.getLogger("kubemark")
 
@@ -103,12 +104,15 @@ class HollowCluster:
         inf.wait_for_sync(30)
         self._shared_informer = inf
 
+        METRICS.set_gauge("kubemark_hollow_nodes", len(self._kubelets))
+
         def hb_loop():
             while not self._stop_evt.wait(heartbeat_period):
                 desired_by_node: Dict[str, set] = {}
                 for p in inf.store.list():
                     desired_by_node.setdefault(p.spec.node_name, set()).add(
                         f"{p.metadata.namespace}/{p.metadata.name}")
+                running = 0
                 for name, kl in self._kubelets.items():
                     kl.heartbeat()
                     # shared-resync: reap runtime pods no longer desired
@@ -116,13 +120,28 @@ class HollowCluster:
                     for key in list(kl.runtime.running()):
                         if key not in desired:
                             kl.runtime.kill_pod(key)
+                    running += len(kl.runtime.running())
+                # the soak scraper's view of the hollow fleet: how many
+                # pods the fake runtimes are actually carrying
+                METRICS.set_gauge("kubemark_hollow_pods_running", running)
 
         self._hb_thread = threading.Thread(target=hb_loop,
                                            name="hollow-heartbeat", daemon=True)
         self._hb_thread.start()
         return self
 
+    def running_pods(self) -> int:
+        """Pods currently held by the hollow runtimes, across all nodes."""
+        return sum(len(kl.runtime.running()) for kl in self._kubelets.values())
+
     def stop(self):
         self._stop_evt.set()
+        # join the heartbeat loop BEFORE zeroing: an in-flight iteration
+        # (seconds of REST calls at 1000 nodes) would otherwise overwrite
+        # the zeros with one last nonzero count after the fleet is gone
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=30)
+        METRICS.set_gauge("kubemark_hollow_nodes", 0)
+        METRICS.set_gauge("kubemark_hollow_pods_running", 0)
         if self._shared_informer:
             self._shared_informer.stop()

@@ -1,0 +1,21 @@
+"""Cluster observatory: scrape -> SLI -> SLO -> soak, plus kernel profiling.
+
+- `scrape`: Prometheus text-exposition parser + multi-target scraper with
+  counter/histogram delta math (SLIs from what components EXPORT).
+- `slo`: declarative SLO specs evaluated as multi-window burn rates,
+  surfaced as metrics + Events.
+- `soak`: the kubemark churn soak harness (sustained create/bind/delete
+  with scraped steady-state SLIs) behind `bench.py --mode soak`.
+- `profiling`: jax.profiler hooks — the always-on host/device time split
+  (`scheduler_kernel_device_seconds`) and the `/profilez` trace windows.
+"""
+
+from kubernetes_tpu.observability.scrape import (  # noqa: F401
+    Family, HistogramSnapshot, Scraper, parse_prometheus_text,
+)
+from kubernetes_tpu.observability.slo import (  # noqa: F401
+    SLOEngine, SLOResult, SLOSpec, Window,
+)
+from kubernetes_tpu.observability.soak import (  # noqa: F401
+    SoakConfig, default_slos, run_soak,
+)

@@ -1,0 +1,431 @@
+"""Pull-based metrics collection over component debugserver /metrics.
+
+The SLO engine and the soak harness must compute SLIs from what components
+actually EXPORT — not from in-process registry globals — or the published
+numbers and the observable surface drift apart (the BENCH_r05 failure mode:
+a wedged run reported as if it were data, because nothing scraped the run
+while it happened). This module is the collector half:
+
+- ``parse_prometheus_text``: a strict parser for the Prometheus text
+  exposition format (the output of ``utils/metrics.render()``): # HELP /
+  # TYPE headers, escaped label values (``\\``, ``\"``, ``\\n``), counter /
+  gauge samples, and histogram ``_bucket``/``_sum``/``_count`` triples
+  reassembled into cumulative-bucket snapshots.
+- ``Scraper``: named HTTP targets, a bounded ring of timestamped rounds per
+  target, and the delta math on top: counter deltas (reset-aware), rates,
+  and histogram-window quantiles between any two rounds — the inputs the
+  SLO burn-rate windows consume.
+
+Scrape failures are themselves observable (``observability_scrape_total``
+with an ``outcome`` label) and never raise out of ``scrape()``: a dead
+component mid-soak is a finding, not a crash.
+"""
+
+from __future__ import annotations
+
+import http.client
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+_ESCAPES = {"\\\\": "\\", '\\"': '"', "\\n": "\n"}
+
+
+def _unescape(s: str) -> str:
+    out, i = [], 0
+    while i < len(s):
+        pair = s[i:i + 2]
+        if pair in _ESCAPES:
+            out.append(_ESCAPES[pair])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(body: str) -> Dict[str, str]:
+    """Parse the inside of a {...} label block, honoring escapes."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        eq = body.index("=", i)
+        name = body[i:eq].strip()
+        if body[eq + 1] != '"':
+            raise ValueError(f"unquoted label value at {body[eq:]!r}")
+        j = eq + 2
+        val = []
+        while j < n:
+            c = body[j]
+            if c == "\\":
+                val.append(body[j:j + 2])
+                j += 2
+                continue
+            if c == '"':
+                break
+            val.append(c)
+            j += 1
+        else:
+            raise ValueError(f"unterminated label value in {body!r}")
+        labels[name] = _unescape("".join(val))
+        i = j + 1
+        while i < n and body[i] in ", ":
+            i += 1
+    return labels
+
+
+def _parse_value(s: str) -> float:
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    return float(s)
+
+
+@dataclass
+class HistogramSnapshot:
+    """Cumulative-bucket state of one histogram series at scrape time."""
+
+    buckets: Dict[float, float] = field(default_factory=dict)  # le -> cum
+    sum: float = 0.0
+    count: float = 0.0
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket holding the q-th observation; NaN for
+        an empty series (no samples != zero latency)."""
+        if self.count <= 0:
+            return float("nan")
+        target = q * self.count
+        for le in sorted(self.buckets):
+            if self.buckets[le] >= target:
+                return le
+        return float("inf")
+
+    def delta(self, before: Optional["HistogramSnapshot"]) -> "HistogramSnapshot":
+        """Observations made between `before` and this snapshot. A count
+        that went backwards means the exporter restarted — the delta is
+        then this snapshot itself (same reset rule as counters)."""
+        if before is None or before.count > self.count:
+            return HistogramSnapshot(dict(self.buckets), self.sum, self.count)
+        return HistogramSnapshot(
+            {le: c - before.buckets.get(le, 0.0)
+             for le, c in self.buckets.items()},
+            self.sum - before.sum, self.count - before.count)
+
+
+@dataclass
+class Family:
+    """One metric family parsed from an exposition."""
+
+    name: str
+    type: str = "untyped"
+    help: str = ""
+    # counter/gauge: label tuple -> value
+    samples: Dict[Tuple, float] = field(default_factory=dict)
+    # histogram: label tuple (le stripped) -> snapshot
+    histograms: Dict[Tuple, HistogramSnapshot] = field(default_factory=dict)
+
+    def value(self, **labels) -> float:
+        return self.samples.get(tuple(sorted(labels.items())), float("nan"))
+
+    def total(self) -> float:
+        """Sum across every label combination (counter families)."""
+        return sum(self.samples.values())
+
+    def histogram(self, **labels) -> Optional[HistogramSnapshot]:
+        return self.histograms.get(tuple(sorted(labels.items())))
+
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Family]:
+    """Parse a /metrics payload into {family name: Family}. Histogram
+    `_bucket`/`_sum`/`_count` samples are folded back into their family
+    (the one `# TYPE <name> histogram` declares)."""
+    families: Dict[str, Family] = {}
+    declared_hist: set = set()
+
+    def fam(name: str) -> Family:
+        f = families.get(name)
+        if f is None:
+            f = families[name] = Family(name)
+        return f
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                f = fam(parts[2])
+                f.type = parts[3].strip() if len(parts) > 3 else "untyped"
+                if f.type == "histogram":
+                    declared_hist.add(parts[2])
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                fam(parts[2]).help = _unescape(
+                    parts[3] if len(parts) > 3 else "")
+            continue
+        # sample line: name[{labels}] value [timestamp]
+        if "{" in line:
+            name = line[:line.index("{")]
+            rest = line[line.index("{") + 1:]
+            # find the real closing brace: '}' inside a QUOTED label value
+            # is literal (the format escapes only \\ \" \n — braces stay
+            # raw), so track quote state, not just backslashes
+            depth_end, j, in_quotes = None, 0, False
+            while j < len(rest):
+                c = rest[j]
+                if c == "\\" and in_quotes:
+                    j += 2
+                    continue
+                if c == '"':
+                    in_quotes = not in_quotes
+                elif c == "}" and not in_quotes:
+                    depth_end = j
+                    break
+                j += 1
+            if depth_end is None:
+                raise ValueError(f"unterminated label block: {line!r}")
+            labels = _parse_labels(rest[:depth_end])
+            value = _parse_value(rest[depth_end + 1:].split()[0])
+        else:
+            name, value_s = line.split(None, 2)[:2]
+            labels, value = {}, _parse_value(value_s)
+
+        base = None
+        for suffix in _HIST_SUFFIXES:
+            if name.endswith(suffix) and name[: -len(suffix)] in declared_hist:
+                base = name[: -len(suffix)]
+                break
+        if base is not None:
+            suffix = name[len(base):]
+            le = labels.pop("le", None)
+            lk = tuple(sorted(labels.items()))
+            snap = fam(base).histograms.setdefault(lk, HistogramSnapshot())
+            if suffix == "_bucket":
+                if le is None:
+                    raise ValueError(f"bucket sample without le: {line!r}")
+                snap.buckets[_parse_value(le)] = value
+            elif suffix == "_sum":
+                snap.sum = value
+            else:
+                snap.count = value
+        else:
+            fam(name).samples[tuple(sorted(labels.items()))] = value
+    return families
+
+
+@dataclass
+class Round:
+    """One timestamped scrape of one target."""
+
+    ts: float
+    families: Dict[str, Family]
+    error: Optional[str] = None
+
+
+class Scraper:
+    """Named /metrics targets + a bounded per-target history of parsed
+    rounds, with the counter/histogram delta math the SLO windows read."""
+
+    def __init__(self, history: int = 256, timeout: float = 5.0,
+                 clock=time.monotonic, registry=METRICS):
+        self._targets: Dict[str, Tuple[str, int, str]] = {}
+        self._rounds: Dict[str, deque] = {}
+        self._history = history
+        self._timeout = timeout
+        self._clock = clock
+        self._registry = registry
+        self._lock = threading.Lock()
+
+    def add_target(self, name: str, host: str, port: int,
+                   path: str = "/metrics") -> None:
+        with self._lock:
+            self._targets[name] = (host, port, path)
+            self._rounds.setdefault(name, deque(maxlen=self._history))
+
+    def targets(self) -> List[str]:
+        with self._lock:
+            return list(self._targets)
+
+    # --- collection ----------------------------------------------------------
+
+    def _fetch(self, host: str, port: int, path: str) -> str:
+        conn = http.client.HTTPConnection(host, port, timeout=self._timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status} from {path}")
+            return body
+        finally:
+            conn.close()
+
+    def scrape(self, name: Optional[str] = None) -> Dict[str, Round]:
+        """Pull one round from every target (or just `name`). Failures are
+        recorded as an error Round + a counter tick, never raised."""
+        with self._lock:
+            todo = ({name: self._targets[name]} if name is not None
+                    else dict(self._targets))
+        out = {}
+        for tname, (host, port, path) in todo.items():
+            try:
+                text = self._fetch(host, port, path)
+                rnd = self.ingest(tname, text)
+                self._registry.inc("observability_scrape_total",
+                                   target=tname, outcome="ok")
+            except Exception as e:
+                rnd = Round(ts=self._clock(), families={}, error=repr(e))
+                with self._lock:
+                    self._rounds[tname].append(rnd)
+                self._registry.inc("observability_scrape_total",
+                                   target=tname, outcome="error")
+            out[tname] = rnd
+        return out
+
+    def ingest(self, name: str, text: str,
+               ts: Optional[float] = None) -> Round:
+        """Parse an exposition payload into the target's history — the seam
+        scrape() feeds and tests drive directly (no HTTP needed)."""
+        rnd = Round(ts=self._clock() if ts is None else ts,
+                    families=parse_prometheus_text(text))
+        with self._lock:
+            self._rounds.setdefault(
+                name, deque(maxlen=self._history)).append(rnd)
+        return rnd
+
+    # --- reading -------------------------------------------------------------
+
+    def last(self, name: str) -> Optional[Round]:
+        with self._lock:
+            rounds = self._rounds.get(name)
+            return rounds[-1] if rounds else None
+
+    def last_good(self, name: str) -> Optional[Round]:
+        """Newest round that actually parsed (scrape failures produce error
+        rounds with empty families — reading those as data would turn 'the
+        target died' into 'every counter reset to zero')."""
+        with self._lock:
+            rounds = self._rounds.get(name, ())
+            for rnd in reversed(rounds):
+                if not rnd.error:
+                    return rnd
+        return None
+
+    def _window_bounds(self, name: str, window_seconds: Optional[float]
+                       ) -> Tuple[Optional[Round], Optional[Round]]:
+        """(start round, newest good round). The start is the last round
+        at-or-before the cutoff, so the delta covers AT LEAST the window —
+        a round landing epsilon past the cutoff (scrape jitter) must not
+        silently shrink a one-period window to nothing."""
+        with self._lock:
+            rounds = [r for r in self._rounds.get(name, ()) if not r.error]
+        if not rounds:
+            return None, None
+        newest = rounds[-1]
+        if window_seconds is None:
+            # adjacent-round delta
+            return (rounds[-2] if len(rounds) > 1 else None), newest
+        cutoff = newest.ts - window_seconds
+        at_or_before = [r for r in rounds if r.ts <= cutoff]
+        return (at_or_before[-1] if at_or_before else rounds[0]), newest
+
+    @staticmethod
+    def _counter_between(old: Optional[Round], new: Round, family: str,
+                         labels: dict) -> float:
+        newf = new.families.get(family)
+        if newf is None:
+            return float("nan")
+        cur = newf.total() if not labels else newf.value(**labels)
+        if math.isnan(cur):
+            return float("nan")
+        if old is None or old is new:
+            return cur
+        oldf = old.families.get(family)
+        prev = (oldf.total() if not labels else oldf.value(**labels)) \
+            if oldf is not None else 0.0
+        if math.isnan(prev):
+            prev = 0.0
+        return cur if cur < prev else cur - prev
+
+    @staticmethod
+    def _hist_between(old: Optional[Round], new: Optional[Round],
+                      family: str, labels: dict) -> HistogramSnapshot:
+        empty = HistogramSnapshot()
+        if new is None:
+            return empty
+        newf = new.families.get(family)
+        if newf is None:
+            return empty
+        snap = newf.histogram(**labels)
+        if snap is None:
+            return empty
+        before = None
+        if old is not None and old is not new:
+            oldf = old.families.get(family)
+            before = oldf.histogram(**labels) if oldf is not None else None
+        return snap.delta(before)
+
+    def counter_delta(self, name: str, family: str,
+                      window_seconds: Optional[float] = None,
+                      **labels) -> float:
+        """Counter increase over the window (or since the previous round).
+        Reset-aware: a value that went backwards restarts the count from
+        the new value. NaN when the series was never scraped."""
+        old, new = self._window_bounds(name, window_seconds)
+        if new is None:
+            return float("nan")
+        return self._counter_between(old, new, family, labels)
+
+    def counter_rate(self, name: str, family: str,
+                     window_seconds: Optional[float] = None,
+                     **labels) -> float:
+        """Per-second counter rate over the window. One _window_bounds
+        call feeds BOTH the numerator delta and the denominator duration —
+        a concurrent scrape between two lookups must not skew the rate."""
+        old, new = self._window_bounds(name, window_seconds)
+        if new is None or old is None or old is new or new.ts <= old.ts:
+            return float("nan")
+        return self._counter_between(old, new, family, labels) \
+            / (new.ts - old.ts)
+
+    def gauge_value(self, name: str, family: str, **labels) -> float:
+        rnd = self.last_good(name)
+        if rnd is None:
+            return float("nan")
+        f = rnd.families.get(family)
+        return float("nan") if f is None else f.value(**labels)
+
+    def hist_delta(self, name: str, family: str,
+                   window_seconds: Optional[float] = None,
+                   **labels) -> HistogramSnapshot:
+        """Histogram observations inside the window (empty snapshot — NaN
+        quantiles — when the series was never scraped)."""
+        old, new = self._window_bounds(name, window_seconds)
+        return self._hist_between(old, new, family, labels)
+
+    def quantile(self, name: str, family: str, q: float,
+                 window_seconds: Optional[float] = None, **labels) -> float:
+        return self.hist_delta(name, family, window_seconds,
+                               **labels).quantile(q)
+
+    def hist_rate(self, name: str, family: str,
+                  window_seconds: Optional[float] = None,
+                  **labels) -> float:
+        """Observations per second over the window, from the histogram's
+        count series — the throughput SLI for latency histograms (each
+        e2e-latency observation IS one scheduled pod). Same single-window
+        contract as counter_rate."""
+        old, new = self._window_bounds(name, window_seconds)
+        if new is None or old is None or old is new or new.ts <= old.ts:
+            return float("nan")
+        return self._hist_between(old, new, family, labels).count \
+            / (new.ts - old.ts)

@@ -1,0 +1,210 @@
+"""Declarative SLOs over scraped SLIs, evaluated as multi-window burn rates.
+
+An ``SLOSpec`` names an SLI (a counter rate, a histogram quantile, or a
+gauge), an objective, and a set of evaluation windows. Evaluation follows
+the SRE multi-window multi-burn-rate pattern: the *burn rate* is how many
+times faster than budget the objective is being consumed —
+
+- for a ``max`` bound (latency, depth): ``burn = sli / objective``
+- for a ``min`` bound (throughput):      ``burn = objective / sli``
+
+so burn <= 1 means "inside objective". A spec fires ("burning") only when
+EVERY window's burn exceeds its threshold: the long window proves the
+violation is sustained, the short window proves it is still happening —
+a transient spike trips neither alone.
+
+"No samples" is explicit, not zero: an SLI that evaluates to NaN in any
+window yields the ``no_data`` verdict (the empty-series lesson from
+``Histogram.quantile``: a silent 0.0 would read as either a perfect
+latency or a dead cluster depending on the bound — both wrong).
+
+Verdicts surface three ways: the returned ``SLOResult`` list (what
+``bench.py --mode soak`` embeds), ``slo_burn_rate{slo,window}`` gauges +
+``slo_evaluations_total{slo,verdict}`` counters on the registry, and —
+when a recorder is wired — ``SLOViolation``/``SLORecovered`` Events
+through the PR-8 correlation stack, so a sustained burn is one
+aggregated Event stream, not a storm.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.observability.scrape import Scraper
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+VERDICT_OK = "ok"
+VERDICT_BURNING = "burning"
+VERDICT_NO_DATA = "no_data"
+
+
+@dataclass(frozen=True)
+class Window:
+    """One evaluation window: the SLI is computed over `seconds` of scrape
+    history and compared against `burn_threshold`."""
+
+    seconds: float
+    burn_threshold: float = 1.0
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    name: str
+    target: str            # scraper target the SLI reads from
+    sli: str               # "rate" | "hist_rate" | "quantile" | "gauge"
+    metric: str            # family name on that target
+    objective: float       # the budget the burn rate is measured against
+    bound: str = "max"     # "max": sli must stay <= objective; "min": >=
+    quantile: float = 0.99  # for sli == "quantile"
+    labels: Tuple[Tuple[str, str], ...] = ()
+    windows: Tuple[Window, ...] = (Window(30.0, 1.0), Window(5.0, 1.0))
+
+    def describe(self) -> str:
+        op = "<=" if self.bound == "max" else ">="
+        sli = (f"p{int(self.quantile * 100)}({self.metric})"
+               if self.sli == "quantile" else f"{self.sli}({self.metric})")
+        return f"{sli} {op} {self.objective:g}"
+
+
+@dataclass
+class WindowResult:
+    seconds: float
+    sli: float
+    burn: float
+    threshold: float
+
+    def as_dict(self) -> dict:
+        from kubernetes_tpu.utils.metrics import finite_round
+        return {"seconds": self.seconds, "sli": finite_round(self.sli),
+                "burn": finite_round(self.burn), "threshold": self.threshold}
+
+
+@dataclass
+class SLOResult:
+    name: str
+    verdict: str
+    objective: str
+    windows: List[WindowResult] = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "verdict": self.verdict,
+                "objective": self.objective,
+                "windows": [w.as_dict() for w in self.windows]}
+
+
+class SLO:
+    """An Event-postable identity for one spec (EventRecorder derives the
+    involved-object kind from the class name)."""
+
+    def __init__(self, name: str):
+        self.metadata = api.ObjectMeta(name=name, namespace="default")
+
+
+class SLOEngine:
+    def __init__(self, scraper: Scraper, specs: Sequence[SLOSpec],
+                 recorder=None, registry=METRICS):
+        self.scraper = scraper
+        self.specs = list(specs)
+        self.recorder = recorder
+        self.registry = registry
+        self._objects: Dict[str, SLO] = {}
+        # SLOs with an open (posted, un-recovered) violation: survives
+        # no_data gaps, so burning -> no_data -> ok still closes the loop
+        self._open_violations: set = set()
+
+    # --- SLI computation -----------------------------------------------------
+
+    def _sli(self, spec: SLOSpec, window: Window) -> float:
+        labels = dict(spec.labels)
+        if spec.sli == "rate":
+            return self.scraper.counter_rate(spec.target, spec.metric,
+                                             window.seconds, **labels)
+        if spec.sli == "quantile":
+            return self.scraper.quantile(spec.target, spec.metric,
+                                         spec.quantile, window.seconds,
+                                         **labels)
+        if spec.sli == "hist_rate":
+            return self.scraper.hist_rate(spec.target, spec.metric,
+                                          window.seconds, **labels)
+        if spec.sli == "gauge":
+            return self.scraper.gauge_value(spec.target, spec.metric,
+                                            **labels)
+        raise ValueError(f"unknown sli type {spec.sli!r}")
+
+    @staticmethod
+    def burn_rate(sli: float, objective: float, bound: str) -> float:
+        """How many times over budget the SLI is; <= 1.0 means healthy.
+        Only NaN (no samples) maps to NaN/no_data — an INFINITE latency SLI
+        (every observation beyond the top bucket) is the worst possible
+        violation and must burn infinitely, not read as missing data."""
+        if math.isnan(sli):
+            return float("nan")
+        if bound == "max":
+            if objective <= 0:
+                return float("inf") if sli > 0 else 0.0
+            return sli / objective  # inf / x = inf: beyond-bucket burns
+        # bound == "min": zero throughput burns infinitely fast
+        if sli <= 0:
+            return float("inf")
+        return objective / sli  # x / inf = 0: infinite throughput is fine
+
+    # --- evaluation ----------------------------------------------------------
+
+    def evaluate_one(self, spec: SLOSpec) -> SLOResult:
+        windows: List[WindowResult] = []
+        # an empty windows tuple is a misconfiguration: with no evidence
+        # the verdict must be no_data, never a permanent default-burning
+        burning, no_data = bool(spec.windows), not spec.windows
+        for w in spec.windows:
+            sli = self._sli(spec, w)
+            burn = self.burn_rate(sli, spec.objective, spec.bound)
+            windows.append(WindowResult(w.seconds, sli, burn,
+                                        w.burn_threshold))
+            if math.isnan(burn):
+                no_data = True
+            elif burn <= w.burn_threshold:
+                burning = False
+            # gauge encoding: -1 = no data; inf clamps to a large finite
+            # value (a beyond-bucket burn must still read as burning)
+            gauge = (-1.0 if math.isnan(burn)
+                     else min(burn, 1e9))
+            self.registry.set_gauge("slo_burn_rate", gauge,
+                                    slo=spec.name, window=f"{w.seconds:g}s")
+        verdict = (VERDICT_NO_DATA if no_data
+                   else VERDICT_BURNING if burning else VERDICT_OK)
+        return SLOResult(spec.name, verdict, spec.describe(), windows)
+
+    def evaluate(self) -> List[SLOResult]:
+        results = []
+        for spec in self.specs:
+            res = self.evaluate_one(spec)
+            results.append(res)
+            self.registry.inc("slo_evaluations_total",
+                              slo=spec.name, verdict=res.verdict)
+            if res.verdict == VERDICT_BURNING:
+                self.registry.inc("slo_violations_total", slo=spec.name)
+            self._post_event(spec, res)
+        return results
+
+    def _post_event(self, spec: SLOSpec, res: SLOResult):
+        if self.recorder is None:
+            return
+        obj = self._objects.setdefault(spec.name, SLO(spec.name))
+        if res.verdict == VERDICT_BURNING:
+            # worst burn including inf (zero throughput burns infinitely —
+            # that must read as "inf", not filter away to a garbled "nan")
+            worst = max((w.burn for w in res.windows
+                         if not math.isnan(w.burn)), default=float("nan"))
+            self._open_violations.add(spec.name)
+            self.recorder.event(
+                obj, "Warning", "SLOViolation",
+                f"{spec.describe()} burning at {worst:.2f}x budget")
+        elif res.verdict == VERDICT_OK and spec.name in self._open_violations:
+            # a no_data gap in between must not leave the violation
+            # dangling forever once the SLI provably recovered
+            self._open_violations.discard(spec.name)
+            self.recorder.event(obj, "Normal", "SLORecovered",
+                                f"{spec.describe()} back inside objective")

@@ -1,0 +1,442 @@
+"""Kubemark churn soak: sustained create/bind/delete with scraped SLIs.
+
+The flagship bench measures one-shot batch solves; BASELINE.json's headline
+metric is *steady-state* "pods/sec + p99 schedule latency". This harness
+closes that gap (ROADMAP item 2): it boots a `HollowCluster` behind a live
+API server, sustains a configurable pod creation rate while deleting the
+oldest pods to hold a bounded in-flight population (real churn, not a
+draining queue), and — crucially — observes the run the way an operator
+would: a `Scraper` polls the component debugserver's `/metrics` every
+round, round SLIs (pods/s, e2e p50/p99, queue wait, watch lag) are computed
+from *scraped* counter/histogram deltas, and an `SLOEngine` evaluates
+multi-window burn rates against declarative objectives as it goes.
+
+Self-observation is the point (the BENCH_r05 postmortem: a wedged run
+reported 0.0 pods/s as if it were a measurement): every phase runs under a
+watchdog deadline, a phase that hangs ends the soak with
+``wedged: true`` + the phase name, and a nonzero scraped
+``scheduler_stage_timeout_total`` delta — the scheduler's own watchdog
+firing mid-churn — also marks the report wedged. ``bench.py --mode soak``
+turns a wedged report into a nonzero exit.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.ops import watchdog
+from kubernetes_tpu.observability.scrape import Scraper
+from kubernetes_tpu.observability.slo import SLOEngine, SLOSpec, Window
+from kubernetes_tpu.utils.metrics import finite_round
+
+log = logging.getLogger("soak")
+
+E2E_HIST = "scheduler_e2e_scheduling_latency_seconds"
+QUEUE_HIST = "scheduler_pod_queue_wait_seconds"
+TIMEOUT_COUNTER = "scheduler_stage_timeout_total"
+
+SOAK_PHASES = ("boot", "churn", "drain", "report")
+
+
+@dataclass
+class SoakConfig:
+    num_nodes: int = 100
+    create_rate: float = 100.0        # sustained pod creations per second
+    duration_seconds: float = 30.0    # churn phase length
+    scrape_period: float = 2.0        # one scrape round + SLO evaluation
+    warmup_rounds: int = 1            # rounds excluded from steady state
+    max_in_flight: int = 0            # live pod cap; 0 = 2s worth of rate
+    batch_size: int = 256
+    heartbeat_period: float = 10.0
+    drain_timeout: float = 30.0       # wait for stragglers after churn
+    # SLO objectives (specs built in default_slos; override via `slos`)
+    slo_pods_per_sec: float = 0.0     # 0 = half the create rate
+    slo_e2e_p99_seconds: float = 4.0
+    slo_watch_lag_seconds: float = 2.0
+    slos: Optional[List[SLOSpec]] = None
+    # per-phase watchdog deadlines; missing phases get defaults
+    phase_deadlines: Dict[str, float] = field(default_factory=dict)
+    # kernel stage deadlines passed through to the BatchScheduler
+    stage_deadlines: Optional[dict] = None
+    # fault injection (tests / chaos): seed a hang in this kernel stage with
+    # a tiny deadline — the soak must end wedged, never hung
+    hang_stage: str = ""
+
+    def in_flight_cap(self) -> int:
+        return self.max_in_flight or max(int(self.create_rate * 2), 50)
+
+    def deadlines(self) -> Dict[str, float]:
+        d = {"boot": 120.0,
+             "churn": self.duration_seconds * 3 + 60.0,
+             "drain": self.drain_timeout * 2 + 30.0,
+             "report": 60.0}
+        d.update(self.phase_deadlines)
+        return d
+
+
+def default_slos(cfg: SoakConfig, target: str) -> List[SLOSpec]:
+    """The BASELINE-shaped objectives: steady pods/s, e2e schedule p99, and
+    informer watch lag, each over a (long, short) burn-rate window pair
+    derived from the scrape period."""
+    long_w, short_w = cfg.scrape_period * 4, cfg.scrape_period
+    windows = (Window(long_w, 1.0), Window(short_w, 1.0))
+    return [
+        SLOSpec(name="pods-per-sec", target=target, sli="hist_rate",
+                metric=E2E_HIST, bound="min",
+                objective=cfg.slo_pods_per_sec or cfg.create_rate / 2,
+                windows=windows),
+        SLOSpec(name="schedule-e2e-p99", target=target, sli="quantile",
+                metric=E2E_HIST, quantile=0.99, bound="max",
+                objective=cfg.slo_e2e_p99_seconds, windows=windows),
+        SLOSpec(name="informer-watch-lag", target=target, sli="gauge",
+                metric="informer_watch_lag_seconds",
+                labels=(("resource", "pods"),), bound="max",
+                objective=cfg.slo_watch_lag_seconds, windows=windows),
+    ]
+
+
+def _e2e_count(rnd) -> float:
+    """Absolute e2e-histogram observation count in a scraped round (0.0
+    when the series hasn't appeared yet)."""
+    fam = rnd.families.get(E2E_HIST) if rnd is not None else None
+    h = fam.histogram() if fam is not None else None
+    return h.count if h is not None else 0.0
+
+
+def _mk_pod(i: int):
+    from kubernetes_tpu.api import types as api
+    return api.Pod(
+        metadata=api.ObjectMeta(name=f"soak-{i:07d}", namespace="default",
+                                labels={"app": "soak"}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="pause",
+            resources=api.ResourceRequirements(
+                requests={"cpu": "100m", "memory": "100Mi"}))]))
+
+
+class _Churner:
+    """Paced create/delete driver: creates pods at `rate`, deletes the
+    oldest once the live population exceeds the cap (bind happens in the
+    scheduler between the two)."""
+
+    def __init__(self, client, rate: float, cap: int):
+        self.client = client
+        self.rate = rate
+        self.cap = cap
+        self.created = 0
+        self.deleted = 0
+        self.create_errors = 0
+        self._live: List[str] = []
+        self._debt = 0.0
+        self._last = None
+
+    def tick(self, now: float) -> None:
+        if self._last is None:
+            self._last = now
+            return
+        self._debt += (now - self._last) * self.rate
+        self._last = now
+        n = int(self._debt)
+        if n <= 0:
+            return
+        self._debt -= n
+        for _ in range(n):
+            try:
+                self.client.create("pods", _mk_pod(self.created))
+                self._live.append(f"soak-{self.created:07d}")
+                self.created += 1
+            except Exception as e:
+                self.create_errors += 1
+                log.warning("soak create failed: %s", e)
+        while len(self._live) > self.cap:
+            name = self._live.pop(0)
+            try:
+                self.client.delete("pods", name, "default")
+                self.deleted += 1
+            except Exception:
+                self.deleted += 1  # already gone: deletion still happened
+
+
+def run_soak(cfg: SoakConfig, scraper: Optional[Scraper] = None) -> dict:
+    """Run the churn soak; returns the report dict bench.py --mode soak
+    emits. Never hangs: each phase runs under a watchdog deadline and a
+    blown deadline ends the run with wedged=true + the phase name."""
+    report: dict = {
+        "mode": "soak",
+        "config": {"nodes": cfg.num_nodes, "create_rate": cfg.create_rate,
+                   "duration_seconds": cfg.duration_seconds,
+                   "scrape_period": cfg.scrape_period,
+                   "in_flight_cap": cfg.in_flight_cap()},
+        "rounds": [], "slos": [], "wedged": False,
+    }
+    state: dict = {}
+    try:
+        watchdog.run_stages(
+            lambda stage: _soak_phases(cfg, report, state, stage, scraper),
+            deadlines=cfg.deadlines(), registry=None)
+    except watchdog.StageTimeout as e:
+        # the harness's own watchdog fired: the soak is wedged IN that
+        # phase — report it instead of hanging. The worker thread is
+        # abandoned mid-call; flag it BEFORE teardown (teardown is what
+        # unblocks it) so when it resumes it stops instead of racing us
+        # for the report dict.
+        state["abandoned"] = True
+        report["wedged"] = True
+        report["wedged_phase"] = e.stage
+        report["error"] = str(e)
+        from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+        METRICS.inc("soak_phase_timeout_total", phase=e.stage)
+    except Exception as e:
+        state["abandoned"] = True
+        report["error"] = repr(e)
+        report["wedged"] = True
+    finally:
+        _teardown(state)
+    return report
+
+
+class SoakAbandoned(RuntimeError):
+    """Raised inside the abandoned worker after a phase timeout: the caller
+    already returned a wedged report; this thread must stop touching it."""
+
+
+def _soak_phases(cfg: SoakConfig, report: dict, state: dict, stage,
+                 scraper: Optional[Scraper]) -> None:
+    def guard(fn):
+        # the worker survives its own abandonment (a hung call eventually
+        # unblocks during teardown); it must then die quietly, not run the
+        # remaining phases against a report the caller already returned
+        def inner():
+            if state.get("abandoned"):
+                raise SoakAbandoned()
+            return fn()
+        return inner
+
+    stage("boot", guard(lambda: _boot(cfg, state, scraper)))
+    stage("churn", guard(lambda: _churn(cfg, state, report)))
+    stage("drain", guard(lambda: _drain(cfg, state, report)))
+    stage("report", guard(lambda: _finalize(cfg, state, report)))
+
+
+def _boot(cfg: SoakConfig, state: dict, scraper: Optional[Scraper]) -> None:
+    """API server + debugserver + HollowCluster + batch scheduler + scraper
+    baseline round."""
+    from kubernetes_tpu.api import binary_codec
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import RESTClient
+    from kubernetes_tpu.client.record import EventRecorder
+    from kubernetes_tpu.kubemark import HollowCluster
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    from kubernetes_tpu.utils.debugserver import DebugServer
+
+    server = state["server"] = APIServer().start()
+    client = state["client"] = RESTClient.for_server(
+        server, qps=50000, burst=50000,
+        content_type=binary_codec.CONTENT_TYPE)
+    hollow = state["hollow"] = HollowCluster(
+        RESTClient.for_server(server, qps=50000, burst=50000),
+        num_nodes=cfg.num_nodes)
+    hollow.start(heartbeat_period=cfg.heartbeat_period)
+    factory = state["factory"] = ConfigFactory(client)
+    factory.run(timeout=60)
+    sched = state["sched"] = factory.create_batch_from_provider(
+        batch_size=cfg.batch_size, stage_deadlines=cfg.stage_deadlines)
+    if cfg.hang_stage:
+        _seed_hang(sched, cfg.hang_stage)
+    # the debug mux every component serves; the scraper reads THIS, not the
+    # in-process registry — SLIs come from what the component exports
+    dbg = state["debug"] = DebugServer(
+        port=0, healthz=sched.healthy,
+        configz={"soak": dict(nodes=cfg.num_nodes,
+                              create_rate=cfg.create_rate)}).start()
+    scr = state["scraper"] = scraper or Scraper()
+    scr.add_target("scheduler", "127.0.0.1", dbg.port)
+    scr.scrape()  # baseline round: deltas in round 1 measure churn only
+    base = scr.last_good("scheduler")
+    if base is None:
+        # no baseline means every later delta would be absolute counter
+        # values — in a long-lived process that miscounts pre-soak history
+        # as this soak's (including phantom wedge verdicts). Fatal.
+        raise RuntimeError("baseline scrape of the scheduler target failed")
+    state["steady_from_ts"] = base.ts
+    # absolute baselines (counter values, not rounds): totals stay correct
+    # even when a long soak outgrows the scraper's bounded round history
+    fam = base.families.get(TIMEOUT_COUNTER)
+    state["timeout_base_by_stage"] = (
+        {dict(lk).get("stage", "?"): v for lk, v in fam.samples.items()}
+        if fam else {})
+    state["e2e_base"] = _e2e_count(base)
+    state["steady_base_count"] = state["e2e_base"]
+    state["engine"] = SLOEngine(
+        scr, cfg.slos if cfg.slos is not None
+        else default_slos(cfg, "scheduler"),
+        recorder=EventRecorder(client, "soak-harness"))
+    sched.run()
+
+
+def _seed_hang(sched, stage_name: str) -> None:
+    """Fault injection: every kernel batch parks inside `stage_name` (with a
+    tiny deadline so the scheduler's watchdog converts it) — the soak must
+    finish wedged via the fallback path, never hang."""
+    sched.stage_deadlines[stage_name] = 0.2
+
+    def hanging(pending, weights=None, device=None, stage=None):
+        run = stage or (lambda _n, fn: fn())
+        return run(stage_name, lambda: time.sleep(3600))
+
+    sched._inc.schedule = hanging
+
+
+def _churn(cfg: SoakConfig, state: dict, report: dict) -> None:
+    churner = state["churner"] = _Churner(
+        state["client"], cfg.create_rate, cfg.in_flight_cap())
+    scr: Scraper = state["scraper"]
+    engine: SLOEngine = state["engine"]
+    state["t0"] = time.monotonic()
+    stop = time.monotonic() + cfg.duration_seconds
+    next_scrape = time.monotonic() + cfg.scrape_period
+    while not state.get("abandoned"):
+        now = time.monotonic()
+        if now >= stop:
+            break
+        churner.tick(now)
+        if now >= next_scrape:
+            next_scrape = now + cfg.scrape_period
+            scr.scrape()
+            _record_round(cfg, state, report, engine)
+        time.sleep(0.01)
+
+
+def _record_round(cfg: SoakConfig, state: dict, report: dict,
+                  engine: SLOEngine) -> None:
+    scr: Scraper = state["scraper"]
+    churner: _Churner = state["churner"]
+    num = finite_round
+
+    delta = scr.hist_delta("scheduler", E2E_HIST)  # adjacent rounds
+    report["rounds"].append({
+        "t": round(time.monotonic() - state.get("t0", time.monotonic()), 2),
+        "created": churner.created, "deleted": churner.deleted,
+        "bound_in_round": int(delta.count),
+        "pods_per_sec": num(scr.hist_rate("scheduler", E2E_HIST)),
+        "e2e_p50_seconds": num(delta.quantile(0.5)),
+        "e2e_p99_seconds": num(delta.quantile(0.99)),
+        "queue_wait_p99_seconds": num(scr.quantile(
+            "scheduler", QUEUE_HIST, 0.99)),
+        "watch_lag_seconds": num(scr.gauge_value(
+            "scheduler", "informer_watch_lag_seconds", resource="pods")),
+        "slos": {r.name: r.verdict for r in engine.evaluate()},
+    })
+    if len(report["rounds"]) == cfg.warmup_rounds:
+        # warmup over: the steady-state aggregate starts at THIS scrape
+        last = scr.last("scheduler")
+        if last is not None:
+            state["steady_from_ts"] = last.ts
+            state["steady_base_count"] = _e2e_count(last)
+
+
+def _drain(cfg: SoakConfig, state: dict, report: dict) -> None:
+    """Stop creating; wait (bounded) for the pending queue to empty so the
+    steady-state window isn't cut off mid-batch."""
+    factory = state["factory"]
+    deadline = time.monotonic() + cfg.drain_timeout
+    while time.monotonic() < deadline and len(factory.pending) > 0:
+        time.sleep(0.05)
+    state["scraper"].scrape()
+
+
+def _finalize(cfg: SoakConfig, state: dict, report: dict) -> None:
+    scr: Scraper = state["scraper"]
+    churner: _Churner = state.get("churner")
+    engine: SLOEngine = state["engine"]
+    sched = state["sched"]
+    num = finite_round
+    out: dict = {}  # staged locally; merged into report in ONE update below
+
+    # the newest PARSED round: an error round (dead target at drain time)
+    # has empty families, which would read as "every counter reset to 0" —
+    # negative pod counts and a silently dropped wedge verdict
+    last = scr.last_good("scheduler")
+    if last is None:
+        if state.get("abandoned"):
+            raise SoakAbandoned()
+        report["error"] = "no successful scrape round; SLIs unknowable"
+        report["wedged"] = True  # can't prove it wasn't
+        return
+    from_ts = state.get("steady_from_ts")
+    if last is not None and from_ts is not None:
+        steady_window = max(last.ts - from_ts, cfg.scrape_period)
+    else:
+        steady_window = max(
+            cfg.duration_seconds - cfg.warmup_rounds * cfg.scrape_period,
+            cfg.scrape_period)
+    # totals from absolute counter baselines (boot / warmup-end snapshots),
+    # NOT from round-window deltas: a soak longer than the scraper's round
+    # history must still count every bind
+    final_count = _e2e_count(last)
+    steady_bound = final_count - state.get("steady_base_count", 0.0)
+    out["pods_created"] = churner.created if churner else 0
+    out["pods_deleted"] = churner.deleted if churner else 0
+    out["create_errors"] = churner.create_errors if churner else 0
+    out["pods_bound"] = int(final_count - state.get("e2e_base", 0.0))
+    # latency quantiles are window-scoped (bounded history: at most the
+    # retained rounds — fine, p50/p99 over the tail is still steady state)
+    steady = scr.hist_delta("scheduler", E2E_HIST, steady_window)
+    out["steady_state"] = {
+        "window_seconds": steady_window,
+        "pods_bound": int(steady_bound),
+        "pods_per_sec": num(steady_bound / steady_window)
+        if steady_window > 0 else None,
+        "e2e_p50_seconds": num(steady.quantile(0.5)),
+        "e2e_p99_seconds": num(steady.quantile(0.99)),
+        "queue_wait_p99_seconds": num(scr.quantile(
+            "scheduler", QUEUE_HIST, 0.99, steady_window)),
+    }
+    out["slos"] = [r.as_dict() for r in engine.evaluate()]
+    out["kernel"] = {
+        "batches": sched.kernel_batches, "pods": sched.kernel_pods,
+        "failures": sched.kernel_failures, "health": sched.health,
+    }
+    rounds = list(scr._rounds.get("scheduler", ()))
+    out["scrape"] = {
+        "target": "scheduler", "rounds": len(rounds),
+        "errors": sum(1 for r in rounds if r.error),
+        # quantiles above only see the retained rounds when true
+        "history_truncated": len(rounds) >= scr._history,
+    }
+    # the wedge verdict, from the SCRAPED surface: the scheduler's own
+    # stage watchdog fired mid-soak (per-stage DELTAS vs the boot baseline
+    # — timeouts from before the soak are not this soak's wedge)
+    fam = last.families.get(TIMEOUT_COUNTER)
+    base_by_stage = state.get("timeout_base_by_stage", {})
+    fired = {}
+    for lk, v in (fam.samples.items() if fam else ()):
+        stage_name = dict(lk).get("stage", "?")
+        delta = v - base_by_stage.get(stage_name, 0.0)
+        if delta > 0:
+            fired[stage_name] = delta
+    if fired:
+        out["wedged"] = True
+        out["stage_timeouts"] = fired
+    # single merge, re-checking abandonment right before it: if the report
+    # phase itself blew its deadline, the caller already returned `report`
+    # — this thread must not mutate it mid-serialization
+    if state.get("abandoned"):
+        raise SoakAbandoned()
+    report.update(out)
+
+
+def _teardown(state: dict) -> None:
+    for key, stopper in (("sched", "stop"), ("factory", "stop"),
+                         ("hollow", "stop"), ("debug", "stop"),
+                         ("server", "stop")):
+        obj = state.get(key)
+        if obj is None:
+            continue
+        try:
+            getattr(obj, stopper)()
+        except Exception:
+            log.exception("soak teardown: %s failed", key)

@@ -1436,11 +1436,24 @@ class IncrementalTensorizer:
         return plan
 
     def _upload_staged(self, plan: list, device=None):
-        """Device transfer of a staged plan; lock-free (see _stage_uploads)."""
+        """Device transfer of a staged plan; lock-free (see _stage_uploads).
+
+        The transfer is materialized HERE (block_until_ready on the arrays
+        actually moved), not lazily inside the solve: the upload stage's
+        wall time, watchdog deadline, and host/device split
+        (`scheduler_kernel_device_seconds{stage="upload"}`) all then
+        describe the transfer itself — a hung H2D copy surfaces as an
+        upload timeout, not a mysterious solve timeout."""
+        import time as _time
+
         import jax
         import jax.numpy as jnp
 
+        from kubernetes_tpu.observability import profiling
+
+        t0 = _time.perf_counter()
         out = {}
+        moved = []
         uploaded = 0
         for k, ver, host, cached in plan:
             if cached is not None:
@@ -1452,7 +1465,13 @@ class IncrementalTensorizer:
             if ver is not None:
                 self._dev_cache[k] = (ver, arr)
             out[k] = arr
+            moved.append(arr)
             uploaded += host.nbytes
+        t_submit = _time.perf_counter()
+        if moved:
+            jax.block_until_ready(moved)
+        profiling.record_dispatch("upload", t_submit - t0,
+                                  _time.perf_counter() - t_submit)
         self.last_upload_bytes = uploaded
         return out
 

@@ -769,7 +769,17 @@ def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
     `stage(name, fn)` (the watchdog/span hook, ops/watchdog.run_stages) sees
     the dispatch as stage "compile" the first time a static shape is traced
     — with a compile-cache hit/miss event recorded, fingerprint-labeled —
-    and as stage "solve" afterwards."""
+    and as stage "solve" afterwards.
+
+    The stage wall time is additionally split into host vs device
+    components (`scheduler_kernel_device_seconds{stage,component}`,
+    observability/profiling.py): the async `_schedule_jit` call returning
+    bounds the host side (trace / lower / compile / dispatch), and the
+    blocking materialization — which cannot complete until the scan has
+    run on device — is the device side."""
+    import time as _time
+
+    from kubernetes_tpu.observability import profiling
     from kubernetes_tpu.utils import platform as plat
 
     key = _dispatch_key(arrays, n_zones, weights, feats)
@@ -778,7 +788,12 @@ def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
 
     def _run():
         before = plat.compile_cache_snapshot() if first else None
-        out = np.asarray(_schedule_jit(arrays, n_zones, weights, feats))
+        t0 = _time.perf_counter()
+        pending = _schedule_jit(arrays, n_zones, weights, feats)
+        t_host = _time.perf_counter()
+        out = np.asarray(pending)  # device execution + D2H, the sync barrier
+        profiling.record_dispatch(name, t_host - t0,
+                                  _time.perf_counter() - t_host)
         if first:
             plat.record_compile_cache_event(before)
         return out
@@ -798,9 +813,20 @@ def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
     run = stage or (lambda _n, fn: fn())
 
     def _upload():
+        import time as _time
+
+        from kubernetes_tpu.observability import profiling
+        t0 = _time.perf_counter()
         arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
         if device is not None:
             arrays = jax.device_put(arrays, device)
+        t_submit = _time.perf_counter()
+        # materialize the transfer inside the upload stage (same contract
+        # as IncrementalTensorizer._upload_staged: a hung H2D copy is an
+        # upload timeout, not a solve timeout)
+        jax.block_until_ready(arrays)
+        profiling.record_dispatch("upload", t_submit - t0,
+                                  _time.perf_counter() - t_submit)
         return arrays
 
     arrays = run("upload", _upload)

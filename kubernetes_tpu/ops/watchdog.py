@@ -81,13 +81,18 @@ def run_stages(work: Callable, deadlines: Optional[Dict[str, float]] = None,
     box: dict = {}
 
     def stage(name: str, fn: Callable):
+        # every stage is also a jax.profiler TraceAnnotation, so an open
+        # /profilez window shows tensorize/upload/compile/solve as named
+        # regions (observability/profiling.py; no-op without a profiler)
+        from kubernetes_tpu.observability.profiling import annotate
         child = span.child(name) if span is not None else None
         with state_lock:
             state["stage"] = name
             state["since"] = time.monotonic()
         t0 = time.perf_counter()
         try:
-            return fn()
+            with annotate(f"ktpu:{name}"):
+                return fn()
         finally:
             dt = time.perf_counter() - t0
             if registry is not None:

@@ -1,4 +1,4 @@
-"""Per-component debug mux: /healthz, /metrics, /configz.
+"""Per-component debug mux: /healthz, /metrics, /configz, /profilez.
 
 Every reference component serves this trio on its own port (scheduler on
 :10251 — plugin/cmd/kube-scheduler/app/server.go:92-108; /configz from
@@ -6,6 +6,11 @@ pkg/util/configz exposes the component's live versioned configuration).
 The component entrypoints (__main__ modules) mount their componentconfig
 object here, closing the round-3 finding that the config types were
 consumed by nothing.
+
+/profilez (the pprof-endpoint analogue, backed by jax.profiler via
+observability/profiling.py) opens/closes a device trace window on the
+LIVE component: GET /profilez for status, /profilez/start?dir=... to open,
+/profilez/stop to close and learn where the trace landed.
 """
 
 from __future__ import annotations
@@ -28,10 +33,17 @@ def render_configz(configz: Dict[str, object]) -> dict:
 
 def debug_route(path: str, healthz: Callable[[], bool],
                 configz: Dict[str, object]):
-    """Shared /healthz /metrics /configz handling for every component
-    server (DebugServer + the kubelet node server). Returns
+    """Shared /healthz /metrics /configz /profilez handling for every
+    component server (DebugServer + the kubelet node server). Returns
     (code, body bytes, content-type) or None when the path isn't a debug
     route."""
+    from urllib.parse import parse_qs, urlsplit
+
+    parts = urlsplit(path)
+    query = parse_qs(parts.query)
+    path = parts.path
+    if path == "/profilez" or path.startswith("/profilez/"):
+        return _profilez(path, query)
     if path in ("/healthz", "/healthz/ping"):
         ok = False
         try:
@@ -49,6 +61,35 @@ def debug_route(path: str, healthz: Callable[[], bool],
         return (200, json.dumps(render_configz(configz)).encode(),
                 "application/json")
     return None
+
+
+def _profilez(path: str, query: Dict[str, list]):
+    """Open/close/inspect a live jax profiler trace window."""
+    from kubernetes_tpu.observability import profiling
+
+    action = path[len("/profilez"):].strip("/") or "status"
+    try:
+        if action == "status":
+            body = profiling.profile_status()
+        elif action == "start":
+            body = profiling.start_profile(
+                (query.get("dir") or [""])[0])
+        elif action == "stop":
+            body = profiling.stop_profile()
+        else:
+            return (404, json.dumps(
+                {"error": f"unknown profilez action {action!r}"}).encode(),
+                "application/json")
+    except RuntimeError as e:
+        # start-while-open / stop-while-idle: caller error, not a crash
+        return (409, json.dumps({"error": str(e)}).encode(),
+                "application/json")
+    except Exception as e:
+        logging.getLogger("debugserver").exception("profilez %s failed",
+                                                   action)
+        return (500, json.dumps({"error": repr(e)}).encode(),
+                "application/json")
+    return 200, json.dumps(body).encode(), "application/json"
 
 
 class DebugServer:

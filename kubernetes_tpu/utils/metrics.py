@@ -17,6 +17,25 @@ from typing import Dict, Tuple
 # (reference metrics.go:31-54)
 SCHEDULER_BUCKETS = tuple(0.001 * 2**k for k in range(15))
 
+# optional # HELP text per metric family, keyed by family name (mutate
+# directly: HELP["my_total"] = "..."); families without an entry render a
+# placeholder so the exposition stays parseable by strict readers
+# (observability/scrape.py round-trips it)
+HELP: Dict[str, str] = {}
+
+
+def finite_round(v, ndigits: int = 4):
+    """JSON-report formatter for SLI values: a finite number rounds, NaN
+    ("no samples") and inf (beyond bucket range) become None — a missing
+    measurement must never serialize as a plausible number. Ints (counts)
+    pass through unrounded."""
+    if isinstance(v, bool) or v is None:
+        return None
+    if isinstance(v, int):
+        return v
+    import math
+    return round(v, ndigits) if math.isfinite(v) else None
+
 
 def _label_key(labels: dict) -> Tuple:
     return tuple(sorted(labels.items()))
@@ -44,12 +63,14 @@ class Histogram:
 
     def quantile(self, q: float, **labels) -> float:
         """Estimated quantile from bucket counts (upper bound of the bucket
-        containing the q-th observation)."""
+        containing the q-th observation). An EMPTY series returns NaN —
+        "no samples" must be distinguishable from a genuine zero latency
+        (bench._finite and the SLO evaluator both branch on it)."""
         k = _label_key(labels)
         counts = self._counts.get(k)
         total = self._totals.get(k, 0)
         if not counts or not total:
-            return 0.0
+            return float("nan")
         target = q * total
         seen = 0
         for i, c in enumerate(counts[:-1]):
@@ -134,11 +155,12 @@ class MetricsRegistry:
 
     def delta_quantile(self, name: str, snap, q: float, **labels) -> float:
         """Quantile over observations made AFTER the snapshot (upper bound
-        of the bucket containing the q-th observation)."""
+        of the bucket containing the q-th observation). NaN when the window
+        holds no samples (same contract as Histogram.quantile)."""
         with self._lock:
             h = self._histograms.get(name)
             if h is None:
-                return 0.0
+                return float("nan")
             before_counts, before_totals = snap
             k = _label_key(labels)
             zero = [0] * (len(h.buckets) + 1)
@@ -146,7 +168,7 @@ class MetricsRegistry:
                                             before_counts.get(k, zero))]
             total = h._totals.get(k, 0) - before_totals.get(k, 0)
         if total <= 0:
-            return 0.0
+            return float("nan")
         seen, target = 0, q * total
         for i, c in enumerate(counts[:-1]):
             seen += c
@@ -155,36 +177,96 @@ class MetricsRegistry:
         return float("inf")
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format: # HELP + # TYPE per family,
+        label values escaped (backslash, quote, newline), `le` bucket bounds
+        formatted through the one shared formatter — a strict parser (the
+        observability scraper included) must round-trip this output."""
         out = []
         with self._lock:
             for name, series in sorted(self._counters.items()):
-                out.append(f"# TYPE {name} counter")
+                _family_header(out, name, "counter")
                 for lk, v in sorted(series.items()):
-                    out.append(f"{name}{_fmt_labels(lk)} {v}")
+                    out.append(f"{name}{_fmt_labels(lk)} {_fmt_value(v)}")
             for name, series in sorted(self._gauges.items()):
-                out.append(f"# TYPE {name} gauge")
+                _family_header(out, name, "gauge")
                 for lk, v in sorted(series.items()):
-                    out.append(f"{name}{_fmt_labels(lk)} {v}")
+                    out.append(f"{name}{_fmt_labels(lk)} {_fmt_value(v)}")
             for name, h in sorted(self._histograms.items()):
-                out.append(f"# TYPE {name} histogram")
+                _family_header(out, name, "histogram")
                 for lk in h._totals:
                     cum = 0
                     for i, b in enumerate(h.buckets):
                         cum += h._counts[lk][i]
-                        out.append(f'{name}_bucket{_fmt_labels(lk, le=b)} {cum}')
-                    out.append(f'{name}_bucket{_fmt_labels(lk, le="+Inf")} {h._totals[lk]}')
-                    out.append(f"{name}_sum{_fmt_labels(lk)} {h._sums[lk]}")
+                        out.append(f'{name}_bucket'
+                                   f'{_fmt_labels(lk, le=_fmt_value(b))} {cum}')
+                    out.append(f'{name}_bucket{_fmt_labels(lk, le="+Inf")} '
+                               f'{h._totals[lk]}')
+                    out.append(f"{name}_sum{_fmt_labels(lk)} "
+                               f"{_fmt_value(h._sums[lk])}")
                     out.append(f"{name}_count{_fmt_labels(lk)} {h._totals[lk]}")
         return "\n".join(out) + "\n"
+
+
+def _family_header(out: list, name: str, mtype: str) -> None:
+    help_text = HELP.get(name, f"{name} ({mtype})")
+    # HELP escaping per the format spec: backslash and newline only
+    help_text = help_text.replace("\\", "\\\\").replace("\n", "\\n")
+    out.append(f"# HELP {name} {help_text}")
+    out.append(f"# TYPE {name} {mtype}")
+
+
+def _escape_label_value(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_value(v: float) -> str:
+    """One canonical float rendering for sample values AND `le` bounds, so
+    a bound compares equal whether read from a bucket line or recomputed
+    from SCHEDULER_BUCKETS (0.016 must never render as 0.016000000000000001
+    on one line and 0.016 on another)."""
+    if v != v:
+        return "NaN"  # a NaN sample must never crash every /metrics scrape
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    # repr = shortest round-tripping decimal (0.016 stays "0.016", never
+    # "0.016000000000000001"); integral values drop the trailing ".0"
+    return str(int(v)) if v == int(v) else repr(float(v))
 
 
 def _fmt_labels(lk: Tuple, **extra) -> str:
     pairs = list(lk) + sorted(extra.items())
     if not pairs:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in pairs)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
     return "{" + inner + "}"
 
 
 REGISTRY = MetricsRegistry()
+
+# core SLI families (components observe these without registering help)
+HELP.update({
+    "scheduler_e2e_scheduling_latency_seconds":
+        "Pod queue pop to CAS-accepted binding, per pod",
+    "scheduler_binding_latency_seconds": "The bind POST round-trip",
+    "scheduler_pod_queue_wait_seconds": "Informer delivery to FIFO pop",
+    "scheduler_informer_delivery_seconds":
+        "Pod creation to first scheduler informer delivery",
+    "scheduler_scheduling_algorithm_latency_seconds":
+        "Kernel (or oracle) solve per batch",
+    "scheduler_stage_seconds":
+        "Kernel pipeline stage wall time (tensorize/upload/compile/solve)",
+    "scheduler_stage_timeout_total":
+        "Watchdog conversions of kernel stage hangs",
+    "scheduler_kernel_device_seconds":
+        "Kernel stage time split into host dispatch vs device execution",
+    "scheduler_kernel_health": "1 ok / 0.5 degraded / 0 failed",
+    "kubelet_pod_startup_latency_seconds":
+        "Pod creation to containers started",
+    "informer_watch_lag_seconds": "Store apply to handler dispatch",
+    "workqueue_depth": "Controller workqueue depth",
+    "compile_cache_events_total":
+        "Persistent XLA cache hit/miss/rejected/disabled, by fingerprint",
+})

@@ -1,5 +1,6 @@
-"""End-to-end scheduling observability (ISSUE 8).
+"""End-to-end scheduling observability (ISSUE 8 + ISSUE 10).
 
+ISSUE 8:
 - Events: recorder correlation (exact-dedup count bumps, similar-storm
   aggregation) and spam-filter semantics, posted through the live apiserver.
 - Pipeline spans: IDs + parent links carried from pod arrival through queue
@@ -11,6 +12,20 @@
   watch lag, and workqueue depth/latency all served on /metrics.
 - Round-5 hardening satellites: federation probe loop, route-controller
   CIDR reclaim, volume-manager lock scope, TLS verification opt-in.
+
+ISSUE 10 (the cluster observatory):
+- Exposition round trip: render() escaping / # HELP / le formatting parsed
+  back losslessly by observability.scrape.parse_prometheus_text.
+- Scraper delta math: counter deltas (reset-aware), windowed rates, and
+  histogram-window quantiles over ingested rounds, plus a live HTTP scrape
+  against a DebugServer.
+- SLO engine: burn-rate arithmetic, multi-window gating (short-only spikes
+  don't fire), explicit no_data on empty series, violation/recovery Events.
+- Soak harness: a tier-1 churn smoke against HollowCluster with scraped
+  steady-state SLIs, and a seeded kernel-stage hang that must end in
+  wedged=true (never a hang, never success-shaped 0.0 pods/s).
+- /profilez: live jax.profiler trace windows over the debug mux, and the
+  always-on scheduler_kernel_device_seconds host/device split.
 """
 
 import io
@@ -567,3 +582,437 @@ class TestHardeningSatellites:
         insecure = RESTClient(tls=True, insecure_skip_verify=True)
         insecure._new_conn(1.0)
         assert METRICS.counter_value("tls_insecure_connections") == before + 1
+
+
+# --- ISSUE 10: exposition round trip + scraper delta math --------------------
+
+class TestScraper:
+    def test_render_parse_round_trip(self):
+        """render() output — escaped labels, # HELP, canonical le bounds —
+        parses back losslessly."""
+        from kubernetes_tpu.observability.scrape import parse_prometheus_text
+        from kubernetes_tpu.utils.metrics import HELP, MetricsRegistry
+
+        r = MetricsRegistry()
+        nasty = 'a\\b"c\nd'
+        r.inc("rt_total", 3, path=nasty, verb="GET")
+        r.set_gauge("rt_gauge", 2.5)
+        for v in (0.003, 0.003, 0.05, 1.7):
+            r.observe("rt_seconds", v, stage="solve")
+        HELP["rt_total"] = 'round "trip" help\nwith newline'
+        try:
+            text = r.render()
+            fams = parse_prometheus_text(text)
+        finally:
+            HELP.pop("rt_total", None)
+
+        assert fams["rt_total"].type == "counter"
+        assert fams["rt_total"].help == 'round "trip" help\nwith newline'
+        assert fams["rt_total"].value(path=nasty, verb="GET") == 3.0
+        assert fams["rt_gauge"].value() == 2.5
+
+        h = fams["rt_seconds"].histogram(stage="solve")
+        assert h is not None and h.count == 4 and abs(h.sum - 1.756) < 1e-9
+        # cumulative bucket counts survive, and the parsed-side quantile
+        # agrees with the registry-side estimator
+        assert h.buckets[0.004] == 2 and h.buckets[float("inf")] == 4
+        reg_q = r.histogram("rt_seconds").quantile(0.5, stage="solve")
+        assert h.quantile(0.5) == reg_q
+
+    def test_brace_in_label_value_round_trips(self):
+        """'}' is legal (unescaped) inside a quoted label value — the
+        parser must track quote state, not stop at the first brace."""
+        from kubernetes_tpu.observability.scrape import parse_prometheus_text
+        from kubernetes_tpu.utils.metrics import MetricsRegistry
+        r = MetricsRegistry()
+        r.inc("brace_total", 2, err="KeyError('{oops}')")
+        fams = parse_prometheus_text(r.render())
+        assert fams["brace_total"].value(err="KeyError('{oops}')") == 2.0
+
+    def test_nan_sample_does_not_crash_render(self):
+        """A NaN gauge/observation must render as 'NaN' (and parse back),
+        never crash every subsequent /metrics scrape."""
+        import math
+
+        from kubernetes_tpu.observability.scrape import parse_prometheus_text
+        from kubernetes_tpu.utils.metrics import MetricsRegistry
+        r = MetricsRegistry()
+        r.set_gauge("bad_gauge", float("nan"))
+        r.observe("bad_seconds", float("nan"))
+        text = r.render()  # must not raise
+        fams = parse_prometheus_text(text)
+        assert math.isnan(fams["bad_gauge"].value())
+
+    def test_le_bounds_are_canonical(self):
+        """Every le value in the exposition must re-parse to exactly the
+        bucket bound it was rendered from (no 0.016000000000000001 drift)."""
+        import re
+
+        from kubernetes_tpu.utils.metrics import (
+            SCHEDULER_BUCKETS, MetricsRegistry,
+        )
+        r = MetricsRegistry()
+        r.observe("le_seconds", 0.01)
+        les = re.findall(r'le="([^"]+)"', r.render())
+        parsed = [float(x) for x in les if x != "+Inf"]
+        assert parsed == sorted(SCHEDULER_BUCKETS)
+
+    def test_empty_histogram_quantile_is_nan(self):
+        """No samples != zero latency: empty series quantiles are NaN, and
+        bench's JSON formatter turns them into null."""
+        import math
+
+        from bench import _finite, _max_finite
+        from kubernetes_tpu.observability.scrape import HistogramSnapshot
+        from kubernetes_tpu.utils.metrics import Histogram, MetricsRegistry
+
+        assert math.isnan(Histogram("empty").quantile(0.99))
+        assert math.isnan(HistogramSnapshot().quantile(0.5))
+        r = MetricsRegistry()
+        snap = r.hist_snapshot("never_observed")
+        assert math.isnan(r.delta_quantile("never_observed", snap, 0.99))
+        assert _finite(float("nan")) is None
+        # max over per-verb quantiles must skip empty series, not poison
+        assert _max_finite([float("nan"), 0.25, 0.5]) == 0.5
+        assert _finite(_max_finite([float("nan")])) is None
+
+    @staticmethod
+    def _text(**counters):
+        lines = []
+        for name, v in counters.items():
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {v}")
+        return "\n".join(lines) + "\n"
+
+    def test_counter_delta_windows_and_reset(self):
+        from kubernetes_tpu.observability.scrape import Scraper
+        s = Scraper()
+        s.add_target("t", "127.0.0.1", 1)  # never fetched: ingest directly
+        s.ingest("t", self._text(c_total=0), ts=0.0)
+        s.ingest("t", self._text(c_total=50), ts=10.0)
+        # adjacent-round delta and windowed rate
+        assert s.counter_delta("t", "c_total") == 50
+        assert s.counter_rate("t", "c_total", 10.0) == pytest.approx(5.0)
+        # a counter that went BACKWARDS is an exporter restart: the delta
+        # restarts from the new value instead of going negative
+        s.ingest("t", self._text(c_total=7), ts=20.0)
+        assert s.counter_delta("t", "c_total") == 7
+        # unknown family: explicit NaN, not zero
+        import math
+        assert math.isnan(s.counter_delta("t", "nope_total"))
+
+    def test_window_covers_at_least_the_period(self):
+        """A round landing epsilon past the cutoff (scrape jitter) must not
+        shrink a one-period window to nothing."""
+        from kubernetes_tpu.observability.scrape import Scraper
+        s = Scraper()
+        s.add_target("t", "127.0.0.1", 1)
+        s.ingest("t", self._text(c_total=0), ts=0.0)
+        s.ingest("t", self._text(c_total=10), ts=1.01)  # 1s period + jitter
+        s.ingest("t", self._text(c_total=30), ts=2.02)
+        # the 1s window reaches back to the round AT-or-before the cutoff
+        assert s.counter_delta("t", "c_total", 1.0) == 20
+        assert s.counter_rate("t", "c_total", 1.0) == pytest.approx(
+            20 / 1.01, rel=1e-6)
+
+    def test_histogram_window_delta(self):
+        from kubernetes_tpu.observability.scrape import Scraper
+        from kubernetes_tpu.utils.metrics import MetricsRegistry
+        r = MetricsRegistry()
+        s = Scraper()
+        s.add_target("t", "127.0.0.1", 1)
+        r.observe("h_seconds", 0.002)
+        s.ingest("t", r.render(), ts=0.0)
+        for v in (0.01, 0.01, 0.3):
+            r.observe("h_seconds", v)
+        s.ingest("t", r.render(), ts=5.0)
+        d = s.hist_delta("t", "h_seconds")
+        assert d.count == 3  # the pre-window observation is excluded
+        assert d.quantile(0.5) == 0.016  # 2 of 3 at 0.01 -> bucket 0.016
+        assert s.hist_rate("t", "h_seconds", 5.0) == pytest.approx(0.6)
+
+    def test_http_scrape_against_debugserver(self):
+        """The live path: DebugServer /metrics -> Scraper -> deltas, with a
+        scrape failure visible as an error round, not an exception."""
+        from kubernetes_tpu.observability.scrape import Scraper
+        from kubernetes_tpu.utils.debugserver import DebugServer
+        dbg = DebugServer(port=0).start()
+        try:
+            s = Scraper()
+            s.add_target("comp", "127.0.0.1", dbg.port)
+            METRICS.inc("scrape_live_total", origin="test")
+            assert s.scrape()["comp"].error is None
+            METRICS.inc("scrape_live_total", 4, origin="test")
+            s.scrape()
+            assert s.counter_delta("comp", "scrape_live_total",
+                                   origin="test") == 4
+        finally:
+            dbg.stop()
+        before = METRICS.counter_value("observability_scrape_total",
+                                       target="comp", outcome="error")
+        rnd = s.scrape()["comp"]  # server is gone now
+        assert rnd.error is not None
+        assert METRICS.counter_value("observability_scrape_total",
+                                     target="comp",
+                                     outcome="error") == before + 1
+
+
+# --- ISSUE 10: SLO burn-rate engine ------------------------------------------
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def event(self, obj, etype, reason, message):
+        self.events.append((obj.metadata.name, etype, reason, message))
+
+
+class TestSLOEngine:
+    E2E = "scheduler_e2e_scheduling_latency_seconds"
+
+    def _scraper_with_rates(self, points):
+        """points: [(ts, counter value)] ingested as rounds."""
+        from kubernetes_tpu.observability.scrape import Scraper
+        s = Scraper()
+        s.add_target("sched", "127.0.0.1", 1)
+        for ts, v in points:
+            s.ingest("sched",
+                     f"# TYPE work_total counter\nwork_total {v}\n", ts=ts)
+        return s
+
+    def test_burn_rate_arithmetic(self):
+        from kubernetes_tpu.observability.slo import SLOEngine
+        import math
+        # max bound (latency): burn = sli / objective
+        assert SLOEngine.burn_rate(2.0, 1.0, "max") == 2.0
+        assert SLOEngine.burn_rate(0.5, 1.0, "max") == 0.5
+        # min bound (throughput): burn = objective / sli; zero burns forever
+        assert SLOEngine.burn_rate(50.0, 100.0, "min") == 2.0
+        assert SLOEngine.burn_rate(0.0, 100.0, "min") == float("inf")
+        # no data propagates as NaN, never as 0-burn
+        assert math.isnan(SLOEngine.burn_rate(float("nan"), 1.0, "max"))
+        # but an INFINITE latency SLI (beyond the top bucket) is the worst
+        # violation, not missing data: it burns infinitely
+        assert SLOEngine.burn_rate(float("inf"), 1.0, "max") == float("inf")
+        assert SLOEngine.burn_rate(float("inf"), 100.0, "min") == 0.0
+
+    def test_beyond_bucket_latency_is_burning_not_no_data(self):
+        """p99 past the last histogram bucket -> inf SLI -> burning."""
+        from kubernetes_tpu.observability.scrape import Scraper
+        from kubernetes_tpu.observability.slo import (
+            SLOEngine, SLOSpec, Window,
+        )
+        from kubernetes_tpu.utils.metrics import MetricsRegistry
+        r = MetricsRegistry()
+        s = Scraper()
+        s.add_target("sched", "127.0.0.1", 1)
+        s.ingest("sched", r.render(), ts=0.0)
+        for _ in range(5):
+            r.observe("slow_seconds", 1000.0)  # past every bucket
+        s.ingest("sched", r.render(), ts=10.0)
+        spec = SLOSpec(name="lat", target="sched", sli="quantile",
+                       metric="slow_seconds", quantile=0.99, objective=1.0,
+                       windows=(Window(10.0, 1.0),))
+        res = SLOEngine(s, [spec]).evaluate()
+        assert res[0].verdict == "burning"
+
+    def test_short_spike_does_not_fire(self):
+        """Multi-window gating: the LONG window must also be out of budget
+        before the verdict is burning."""
+        from kubernetes_tpu.observability.slo import (
+            SLOEngine, SLOSpec, Window,
+        )
+        # 1/s for 10s, then 10/s for the last 2s: the short window is fine
+        # while the long window average (2.5/s) violates a >=5/s objective
+        s = self._scraper_with_rates([(0, 0), (10, 10), (12, 30)])
+        spec = SLOSpec(name="tput", target="sched", sli="rate",
+                       metric="work_total", objective=5.0, bound="min",
+                       windows=(Window(12.0, 1.0), Window(2.0, 1.0)))
+        res = SLOEngine(s, [spec]).evaluate_one(spec)
+        # long window burning (2.5/s < 5/s) but short window healthy
+        assert res.windows[0].burn > 1.0 and res.windows[1].burn <= 1.0
+        assert res.verdict == "ok"
+
+    def test_sustained_burn_fires_and_recovers_with_events(self):
+        from kubernetes_tpu.observability.slo import (
+            SLOEngine, SLOSpec, Window,
+        )
+        rec = _Recorder()
+        s = self._scraper_with_rates([(0, 0), (10, 10), (12, 12)])  # 1/s
+        spec = SLOSpec(name="tput", target="sched", sli="rate",
+                       metric="work_total", objective=5.0, bound="min",
+                       windows=(Window(12.0, 1.0), Window(2.0, 1.0)))
+        engine = SLOEngine(s, [spec], recorder=rec)
+        res = engine.evaluate()
+        assert res[0].verdict == "burning"
+        assert METRICS.counter_value("slo_violations_total", slo="tput") >= 1
+        assert rec.events and rec.events[-1][2] == "SLOViolation"
+        # recovery: rate jumps to 20/s in both windows
+        s.ingest("sched", "# TYPE work_total counter\nwork_total 252\n",
+                 ts=24.0)
+        s.ingest("sched", "# TYPE work_total counter\nwork_total 292\n",
+                 ts=26.0)
+        res = engine.evaluate()
+        assert res[0].verdict == "ok"
+        assert rec.events[-1][2] == "SLORecovered"
+
+    def test_recovery_survives_no_data_gap(self):
+        """burning -> (scrape outage: no_data) -> ok must still post
+        SLORecovered — a dangling SLOViolation never closes otherwise."""
+        from kubernetes_tpu.observability.scrape import Scraper
+        from kubernetes_tpu.observability.slo import (
+            SLOEngine, SLOSpec, Window,
+        )
+        rec = _Recorder()
+        s = Scraper()
+        s.add_target("sched", "127.0.0.1", 1)
+        spec = SLOSpec(name="tput", target="sched", sli="rate",
+                       metric="work_total", objective=5.0, bound="min",
+                       windows=(Window(10.0, 1.0),))
+        engine = SLOEngine(s, [spec], recorder=rec)
+        s.ingest("sched", "# TYPE work_total counter\nwork_total 0\n", ts=0)
+        s.ingest("sched", "# TYPE work_total counter\nwork_total 10\n",
+                 ts=10)
+        assert engine.evaluate()[0].verdict == "burning"
+        # outage round: family missing entirely -> no_data
+        s.ingest("sched", "", ts=12)
+        s.ingest("sched", "", ts=14)
+        assert engine.evaluate()[0].verdict == "no_data"
+        # recovered at 20/s
+        s.ingest("sched", "# TYPE work_total counter\nwork_total 210\n",
+                 ts=20)
+        s.ingest("sched", "# TYPE work_total counter\nwork_total 260\n",
+                 ts=22)
+        assert engine.evaluate()[0].verdict == "ok"
+        assert rec.events[-1][2] == "SLORecovered"
+
+    def test_empty_windows_is_no_data_not_burning(self):
+        from kubernetes_tpu.observability.slo import SLOEngine, SLOSpec
+        s = self._scraper_with_rates([(0, 0), (10, 10)])
+        spec = SLOSpec(name="cfg", target="sched", sli="rate",
+                       metric="work_total", objective=5.0, bound="min",
+                       windows=())
+        assert SLOEngine(s, [spec]).evaluate()[0].verdict == "no_data"
+
+    def test_no_data_is_explicit(self):
+        """An SLI over a never-observed series is no_data — not ok (a dead
+        exporter must not read as a met objective) and not burning."""
+        from kubernetes_tpu.observability.slo import (
+            SLOEngine, SLOSpec, Window,
+        )
+        s = self._scraper_with_rates([(0, 0), (10, 10)])
+        spec = SLOSpec(name="lat", target="sched", sli="quantile",
+                       metric=self.E2E, quantile=0.99, objective=1.0,
+                       windows=(Window(10.0, 1.0),))
+        res = SLOEngine(s, [spec]).evaluate()
+        assert res[0].verdict == "no_data"
+        assert res[0].windows[0].as_dict()["sli"] is None
+
+
+# --- ISSUE 10: churn soak harness --------------------------------------------
+
+class TestSoakHarness:
+    def test_soak_smoke_steady_state_from_scrape(self):
+        """Tier-1 smoke: sustained create/bind/delete against hollow nodes;
+        steady-state pods/s and p50/p99 computed from SCRAPED deltas; SLOs
+        evaluated; kernel device/host split exported; not wedged."""
+        from kubernetes_tpu.observability.scrape import Scraper
+        from kubernetes_tpu.observability.soak import SoakConfig, run_soak
+
+        scraper = Scraper()
+        cfg = SoakConfig(num_nodes=6, create_rate=30, duration_seconds=2.5,
+                         scrape_period=0.8, batch_size=32,
+                         heartbeat_period=2.0, drain_timeout=20,
+                         slo_e2e_p99_seconds=30.0, slo_watch_lag_seconds=30.0)
+        report = run_soak(cfg, scraper=scraper)
+        assert report.get("error") is None, report
+        assert report["wedged"] is False
+        assert report["pods_created"] > 0
+        assert report["pods_bound"] > 0
+        steady = report["steady_state"]
+        assert steady["pods_per_sec"] is not None and steady["pods_per_sec"] > 0
+        assert steady["e2e_p50_seconds"] is not None
+        assert report["rounds"], "no scrape rounds recorded"
+        verdicts = {s["name"]: s["verdict"] for s in report["slos"]}
+        assert set(verdicts) == {"pods-per-sec", "schedule-e2e-p99",
+                                 "informer-watch-lag"}
+        # the SLIs came from the exported surface, and the device profiling
+        # split rode along on the same scrape
+        last = scraper.last("scheduler")
+        assert last is not None and not last.error
+        fam = last.families.get("scheduler_kernel_device_seconds")
+        assert fam is not None, "host/device split missing from /metrics"
+        comps = {dict(lk).get("component") for lk in fam.histograms}
+        assert {"host", "device"} <= comps
+        # kubemark exported its fleet gauge on the same surface
+        assert last.families.get("kubemark_hollow_nodes") is not None
+
+    def test_seeded_stage_hang_ends_wedged_not_hung(self):
+        """The BENCH_r05 regression proof: a kernel stage that hangs every
+        batch must end the soak with wedged=true + the stage named (binding
+        still completing via the sequential fallback), never a 600s wedge
+        and never success-shaped output."""
+        from kubernetes_tpu.observability.soak import SoakConfig, run_soak
+
+        cfg = SoakConfig(num_nodes=4, create_rate=20, duration_seconds=2.0,
+                         scrape_period=0.8, batch_size=16,
+                         heartbeat_period=2.0, drain_timeout=20,
+                         hang_stage="tensorize")
+        t0 = time.monotonic()
+        report = run_soak(cfg)
+        assert time.monotonic() - t0 < 90, "soak failed to bound the hang"
+        assert report["wedged"] is True
+        assert "tensorize" in report.get("stage_timeouts", {})
+        # the fallback kept scheduling: a wedge is visible, not fatal
+        assert report["pods_bound"] > 0
+        assert report["kernel"]["failures"] >= 1
+
+
+# --- ISSUE 10: /profilez + device profiling ----------------------------------
+
+class TestProfilez:
+    def _get(self, port, path):
+        import http.client as hc
+        import json as _json
+        # generous: the FIRST /profilez/start pays the jax.profiler import
+        # inside the handler thread
+        conn = hc.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, _json.loads(resp.read().decode())
+        finally:
+            conn.close()
+
+    def test_profilez_trace_window_round_trip(self, tmp_path):
+        """status -> start -> (device work) -> stop over the live debug mux;
+        double-start and stop-while-idle are 409s, not crashes."""
+        import jax.profiler  # noqa: F401 — warm the import off the handler
+
+        from kubernetes_tpu.utils.debugserver import DebugServer
+        dbg = DebugServer(port=0).start()
+        try:
+            code, body = self._get(dbg.port, "/profilez")
+            assert code == 200 and body == {"active": False}
+            code, body = self._get(
+                dbg.port, f"/profilez/start?dir={tmp_path / 'trace'}")
+            assert code == 200 and body["active"] is True
+            code, _ = self._get(dbg.port, "/profilez/start")
+            assert code == 409  # one window at a time
+            import jax.numpy as jnp
+            jnp.asarray([1.0, 2.0]).sum().block_until_ready()
+            code, body = self._get(dbg.port, "/profilez/stop")
+            assert code == 200 and body["active"] is False
+            assert body["dir"] == str(tmp_path / "trace")
+            code, _ = self._get(dbg.port, "/profilez/stop")
+            assert code == 409
+        finally:
+            dbg.stop()
+            from kubernetes_tpu.observability import profiling
+            if profiling.profile_status().get("active"):
+                profiling.stop_profile()
+
+    def test_stage_annotation_is_noop_safe(self):
+        from kubernetes_tpu.observability.profiling import annotate
+        with annotate("ktpu:test-stage"):
+            pass  # must never raise, profiler or not

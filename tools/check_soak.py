@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Schema check for `bench.py --mode soak` output (tools/verify.sh step 3).
+
+Validates the report shape the soak smoke just emitted — stdlib only, no
+jsonschema dependency. Exit 0 on a conforming report, 1 with one line per
+violation otherwise. A `--expect-wedged` run inverts the wedge assertion
+(used to prove the seeded-hang path stays honest).
+"""
+
+import json
+import sys
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def check(doc: dict, expect_wedged: bool) -> list:
+    errs = []
+
+    def need(obj, key, pred, where, desc):
+        if not isinstance(obj, dict) or key not in obj:
+            errs.append(f"{where}.{key}: missing")
+        elif not pred(obj[key]):
+            errs.append(f"{where}.{key}: {desc}, got {obj[key]!r}")
+
+    need(doc, "metric",
+         lambda v: isinstance(v, str) and "pods_scheduled_per_sec" in v,
+         "$", "metric string naming pods_scheduled_per_sec")
+    need(doc, "value", _is_num, "$", "number")
+    need(doc, "unit", lambda v: v == "pods/s", "$", "'pods/s'")
+    need(doc, "vs_baseline", _is_num, "$", "number")
+    need(doc, "wedged", lambda v: isinstance(v, bool), "$", "bool")
+    need(doc, "detail", lambda v: isinstance(v, dict), "$", "object")
+    detail = doc.get("detail") or {}
+    need(detail, "mode", lambda v: v == "soak", "detail", "'soak'")
+    need(detail, "rounds", lambda v: isinstance(v, list) and v,
+         "detail", "non-empty list")
+    need(detail, "slos", lambda v: isinstance(v, list), "detail", "list")
+    need(detail, "wedged", lambda v: isinstance(v, bool), "detail", "bool")
+    need(detail, "config", lambda v: isinstance(v, dict), "detail", "object")
+
+    for i, rnd in enumerate(detail.get("rounds") or []):
+        where = f"detail.rounds[{i}]"
+        need(rnd, "created", _is_num, where, "number")
+        need(rnd, "bound_in_round", _is_num, where, "number")
+        need(rnd, "slos", lambda v: isinstance(v, dict), where, "object")
+        for key in ("pods_per_sec", "e2e_p50_seconds", "e2e_p99_seconds"):
+            need(rnd, key, lambda v: v is None or _is_num(v), where,
+                 "number or null (null = no samples, never fake zero)")
+
+    for i, slo in enumerate(detail.get("slos") or []):
+        where = f"detail.slos[{i}]"
+        need(slo, "name", lambda v: isinstance(v, str) and v, where, "name")
+        need(slo, "verdict", lambda v: v in ("ok", "burning", "no_data"),
+             where, "ok|burning|no_data")
+        need(slo, "windows", lambda v: isinstance(v, list) and v, where,
+             "non-empty list")
+
+    if expect_wedged:
+        if not doc.get("wedged"):
+            errs.append("$.wedged: expected true (seeded hang must be "
+                        "reported, not laundered into a success)")
+    else:
+        if doc.get("wedged"):
+            errs.append("$.wedged: true — the soak smoke wedged")
+        steady = detail.get("steady_state") or {}
+        need(steady, "pods_per_sec", _is_num, "detail.steady_state",
+             "number (a clean soak must measure throughput)")
+        need(steady, "pods_bound",
+             lambda v: _is_num(v) and v > 0, "detail.steady_state",
+             "positive (a clean soak must bind pods)")
+    return errs
+
+
+def main(argv) -> int:
+    expect_wedged = "--expect-wedged" in argv
+    paths = [a for a in argv if not a.startswith("-")]
+    if len(paths) != 1:
+        print("usage: check_soak.py [--expect-wedged] <report.json>",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(paths[0], encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_soak: cannot read {paths[0]}: {e}", file=sys.stderr)
+        return 1
+    errs = check(doc, expect_wedged)
+    for e in errs:
+        print(f"check_soak: {e}", file=sys.stderr)
+    if not errs:
+        print(f"check_soak: OK ({paths[0]})")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

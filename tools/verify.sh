@@ -8,19 +8,27 @@
 #                         tracker + checked informer store); any recorded
 #                         inversion or cache mutation fails the test that
 #                         triggered it
+#   3. soak smoke       — a ~10 s kubemark churn soak through
+#                         `bench.py --mode soak` (scraped SLIs, SLO
+#                         verdicts, wedge detection), schema-checked by
+#                         tools/check_soak.py — the steady-state bench path
+#                         is exercised on every verify, not just on bench
+#                         rounds
 #
-# Usage: tools/verify.sh [--static-only|--tests-only]
+# Usage: tools/verify.sh [--static-only|--tests-only|--soak-only]
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 run_static=1
 run_tests=1
+run_soak=1
 case "${1:-}" in
-  --static-only) run_tests=0 ;;
-  --tests-only)  run_static=0 ;;
+  --static-only) run_tests=0; run_soak=0 ;;
+  --tests-only)  run_static=0; run_soak=0 ;;
+  --soak-only)   run_static=0; run_tests=0 ;;
   "") ;;
-  *) echo "usage: tools/verify.sh [--static-only|--tests-only]" >&2; exit 2 ;;
+  *) echo "usage: tools/verify.sh [--static-only|--tests-only|--soak-only]" >&2; exit 2 ;;
 esac
 
 if [ "$run_static" = 1 ]; then
@@ -32,6 +40,16 @@ if [ "$run_tests" = 1 ]; then
   echo "== tier-1 tests (race detectors on) =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
+fi
+
+if [ "$run_soak" = 1 ]; then
+  echo "== soak smoke (churn + scraped SLIs + schema check) =="
+  soak_out="$(mktemp /tmp/soak-smoke.XXXXXX.json)"
+  JAX_PLATFORMS=cpu SOAK_NODES=8 SOAK_RATE=40 SOAK_DURATION=4 \
+    SOAK_SCRAPE_PERIOD=1 SOAK_BATCH=32 \
+    timeout -k 10 300 python bench.py --mode soak > "$soak_out"
+  python tools/check_soak.py "$soak_out"
+  rm -f "$soak_out"
 fi
 
 echo "verify: OK"
