@@ -370,9 +370,23 @@ def stage_timeout_counts() -> dict:
                 "scheduler_stage_timeout_total").items() if v}
 
 
+def flight_dump(reason, trigger=None):
+    """Best-effort forensic bundle (observability/flightrecorder); returns
+    the bundle path or None. A failed dump must never mask the error that
+    triggered it."""
+    try:
+        from kubernetes_tpu.observability.flightrecorder import RECORDER
+        return RECORDER.dump(reason, trigger=trigger)
+    except Exception as e:
+        print(f"bench: flight-recorder dump failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def fail_json(stage, err, **detail):
     timeouts = stage_timeout_counts()
-    print(json.dumps({
+    bundle = flight_dump("bench-failed",
+                         trigger={"stage": stage, "exception": repr(err)})
+    out = {
         "metric": METRIC,
         "value": 0.0,
         "unit": "pods/s",
@@ -380,7 +394,10 @@ def fail_json(stage, err, **detail):
         "wedged": bool(timeouts),
         "error": {"stage": stage, "exception": repr(err), **detail},
         "pipeline": pipeline_breakdown(),
-    }))
+    }
+    if bundle:
+        out["flight_recorder_bundle"] = bundle
+    print(json.dumps(out))
 
 
 def _finite(q: float):
@@ -781,6 +798,13 @@ def main() -> int:
     result["wedged"] = bool(timeouts)
     if timeouts:
         result["detail"]["stage_timeouts"] = timeouts
+        # a wedged round ships its black box: spans (incl. the timed-out
+        # stage), audit tail, events, metric deltas — the next BENCH attempt
+        # is diagnosable from artifacts alone
+        bundle = flight_dump("bench-wedged",
+                             trigger={"stage_timeouts": timeouts})
+        if bundle:
+            result["flight_recorder_bundle"] = bundle
     print(json.dumps(result))
     if restart is not None and restart.get("error"):
         return 1  # a failed restart probe is not a clean measurement
@@ -820,6 +844,11 @@ def main_soak() -> int:
         "wedged": bool(report.get("wedged")),
         "detail": report,
     }
+    # surface the black-box bundle at top level too: artifact consumers
+    # (check_soak, the next postmortem) shouldn't have to know the soak
+    # report's internals to find it
+    if report.get("flight_recorder_bundle"):
+        result["flight_recorder_bundle"] = report["flight_recorder_bundle"]
     print(json.dumps(result))
     return 1 if report.get("wedged") or report.get("error") else 0
 

@@ -170,6 +170,7 @@ def all_checkers() -> List[Checker]:
         SwallowedExceptionChecker,
     )
     from kubernetes_tpu.analysis.locks import LockHeldAcrossIOChecker
+    from kubernetes_tpu.analysis.spans import LeakedSpanChecker
     return [
         LockHeldAcrossIOChecker(),
         CacheMutationChecker(),
@@ -177,6 +178,7 @@ def all_checkers() -> List[Checker]:
         SwallowedExceptionChecker(),
         MonotonicDurationChecker(),
         NonDaemonThreadChecker(),
+        LeakedSpanChecker(),
     ]
 
 

@@ -1,0 +1,141 @@
+"""leaked-span: a Span must provably reach finish() (or change owners).
+
+A `utils/trace.Span` that is constructed but never finished is invisible —
+it never lands in the recent-spans ring, never exports its SLI histogram,
+and silently holds its subtree open. The classic shape is
+
+    sp = Span("work")
+    do_things()      # raises -> finish() below never runs
+    sp.finish()
+
+which is exactly the swallowed-exception class of bug transplanted to
+tracing; this checker mirrors that checker's plumbing (pure-AST, per-scope
+scan, suppressible with ``# kube-verify: disable``).
+
+Flagged:
+
+- a bare ``Span(...)`` expression statement — created, unreferenceable,
+  unfinishable;
+- ``x = Span(...)`` where, within the same function scope, ``x`` is
+  neither ``.finish()``ed inside some ``finally:`` block nor handed off.
+
+"Handed off" (ownership moves, the creator is not responsible for
+finishing) means: returned or yielded, stored into an attribute /
+subscript / container, or woven into another binding's value. A plain
+straight-line ``x.finish()`` does NOT count as safe — any statement
+between creation and that call can raise and skip it; putting the finish
+in a ``finally`` is the fix the checker is steering toward.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Set
+
+from kubernetes_tpu.analysis.core import (
+    Checker,
+    FileContext,
+    Finding,
+    dotted_chain,
+    walk_same_scope,
+)
+
+
+def _is_span_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = dotted_chain(node.func)
+    return bool(chain) and chain[-1] == "Span"
+
+
+def _walk_shallow(stmts: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statement bodies without descending into nested scopes (the
+    same containment rule as walk_same_scope, over an explicit body)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _handoff_names(node: ast.AST) -> Set[str]:
+    """Names whose OBJECT is woven into this expression — i.e. the bare
+    name appears, not merely an attribute read off it. `sp` in `[sp, None]`
+    or `other = sp` hands the span over; `tid = sp.trace_id` only reads a
+    field and must NOT suppress the leak check."""
+    out: Set[str] = set()
+    stack: List[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name):
+            continue  # plain attribute read: the object itself stays put
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+class LeakedSpanChecker(Checker):
+    name = "leaked-span"
+    description = ("Span created without a finally-guarded finish() or an "
+                   "ownership hand-off — an exception on the way leaks the "
+                   "span (no ring entry, no SLI export)")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        scopes: List[ast.AST] = [tree]
+        scopes += [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._check_scope(scope, ctx)
+
+    def _check_scope(self, scope: ast.AST,
+                     ctx: FileContext) -> Iterable[Finding]:
+        created = {}  # local name -> the creating Assign's value node
+        for node in walk_same_scope(scope):
+            if isinstance(node, ast.Expr) and _is_span_ctor(node.value):
+                yield self.finding(
+                    ctx, node,
+                    "Span created and immediately discarded — it can never "
+                    "be finished; bind it and finish in a finally")
+            elif isinstance(node, ast.Assign) and _is_span_ctor(node.value):
+                if len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    created[node.targets[0].id] = node
+        if not created:
+            return
+
+        finished_in_finally: Set[str] = set()
+        escaped: Set[str] = set()
+        for node in walk_same_scope(scope):
+            if isinstance(node, ast.Try):
+                for sub in _walk_shallow(node.finalbody):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Attribute) and \
+                            sub.func.attr == "finish" and \
+                            isinstance(sub.func.value, ast.Name):
+                        finished_in_finally.add(sub.func.value.id)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None:
+                    escaped |= _handoff_names(node.value)
+            elif isinstance(node, ast.Assign):
+                # storing the span anywhere but a plain rebind of itself
+                # moves ownership: self.sp = sp / live[key] = [sp, None] /
+                # other = sp
+                if any(not isinstance(t, ast.Name) for t in node.targets) \
+                        or node is not created.get(
+                            getattr(node.targets[0], "id", None)):
+                    if not _is_span_ctor(node.value):
+                        escaped |= _handoff_names(node.value)
+
+        for name, node in created.items():
+            if name in finished_in_finally or name in escaped:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"Span {name!r} has no finally-guarded finish() and never "
+                "changes owner — an exception between creation and its "
+                "finish() leaks it; wrap in try/finally")

@@ -34,6 +34,7 @@ import logging
 import re
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -46,8 +47,12 @@ from kubernetes_tpu.api.serialization import scheme, to_dict
 from kubernetes_tpu.registry.generic import (
     RESOURCES, Registry, RegistryError, bad_request,
 )
+from kubernetes_tpu.observability.audit import (
+    AUDIT, AuditRecord, now_iso, render_auditz,
+)
 from kubernetes_tpu.storage import TooOldResourceVersion
 from kubernetes_tpu.storage import store as store_mod
+from kubernetes_tpu.utils import trace
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 _PATH = re.compile(
@@ -89,8 +94,17 @@ class APIServer:
                  authenticator=None, authorizer=None,
                  max_in_flight: int = 400,
                  tls_cert_file: str = "", tls_key_file: str = "",
-                 client_ca_file: str = ""):
+                 client_ca_file: str = "", audit_log_path: str = ""):
         self.registry = registry or Registry()
+        # audit sink: the in-memory ring is always on (the AUDIT singleton,
+        # served at /auditz); a path (or KTPU_AUDIT_LOG) adds the rotating
+        # on-disk JSON-lines trail (reference --audit-log-path + maxsize).
+        # The sink is process-wide (last open wins, like the metrics
+        # registry); a server that opened it closes it again in stop() so a
+        # stopped server's file handle doesn't capture later servers' traffic
+        self._audit_sink_path = audit_log_path
+        if audit_log_path:
+            AUDIT.open(audit_log_path)
         self._host = host
         self._port = port
         # secure serving (reference genericapiserver.go:638 secure port +
@@ -188,6 +202,11 @@ class APIServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._audit_sink_path:
+            # release only OUR sink: a newer server may have re-pointed the
+            # process-wide log since, and its trail must keep flowing
+            AUDIT.close_if(self._audit_sink_path)
+            self._audit_sink_path = ""
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -213,6 +232,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             body = json.dumps(payload, separators=(",", ":")).encode()
             ctype = "application/json"
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
@@ -247,6 +267,38 @@ class _Handler(BaseHTTPRequestHandler):
     # --- dispatch ------------------------------------------------------------
 
     def _route(self, method: str):
+        # per-request trace context: adopt the client's traceparent (same
+        # trace id, client span as remote parent) or mint a root trace —
+        # either way every audit record carries a usable trace id. The
+        # CAS-retry counter is request-scoped and read back at audit time.
+        # reset per request: the HTTP/1.1 keep-alive handler instance is
+        # reused, and a stale _user from the previous request would be
+        # attributed to one that never authenticated (a lying audit trail)
+        self._user = None
+        if self.path.startswith("/healthz"):
+            # liveness probes get neither a span nor an audit record: a
+            # hollow fleet's probe traffic would flood both rings with noise
+            self._span = None
+            self._status = 0
+            return self._route_guarded(method)
+        t0 = time.perf_counter()
+        parsed_tp = trace.parse_traceparent(
+            self.headers.get(trace.TRACEPARENT_HEADER))
+        self._span = trace.Span(
+            "apiserver_request",
+            trace_id=parsed_tp[0] if parsed_tp else None,
+            parent_id=parsed_tp[1] if parsed_tp else "",
+            verb=method, path=self.path)
+        self._status = 0
+        self._audited = False
+        self._t0 = t0
+        trace.reset_cas_retries()
+        try:
+            self._route_guarded(method)
+        finally:
+            self._finish_audit(method, t0)
+
+    def _route_guarded(self, method: str):
         # watch streams live for hours; timing them as requests would poison
         # the latency histogram (they have their own counter), and they are
         # exempt from the in-flight cap (longRunningRequestCheck)
@@ -271,7 +323,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             with timer:
                 try:
-                    self._route_inner(method)
+                    with trace.use_span(self._span):
+                        self._route_inner(method)
                 except RegistryError as e:
                     self._send_status(e.code, e.reason, e.message)
                 except TooOldResourceVersion as e:
@@ -292,6 +345,38 @@ class _Handler(BaseHTTPRequestHandler):
             if sem is not None:
                 sem.release()
 
+    def _finish_audit(self, method: str, t0: float):
+        """Close the request span and emit the audit record (health probes
+        never get here — _route skips them). Long-running watch streams
+        audit at stream START instead (_serve_watch) — their audit record
+        must not wait hours for the connection to die."""
+        span = self._span
+        span.attrs["status"] = self._status
+        span.finish()
+        if self._audited:
+            return
+        self._audited = True
+        self._emit_audit(method, self._status, t0)
+
+    def _emit_audit(self, verb: str, status: int, t0: float):
+        """Build + record one AuditRecord from the request's span/headers —
+        the single constructor both the request path and the watch-open
+        path use, so the two record shapes cannot drift."""
+        user = getattr(self, "_user", None)
+        try:
+            retries = int(self.headers.get(trace.RETRY_HEADER, 0) or 0)
+        except ValueError:
+            retries = 0
+        AUDIT.record(AuditRecord(
+            ts=now_iso(), verb=verb, path=self.path,
+            component=self.headers.get("User-Agent") or "",
+            user=user.name if user is not None else "",
+            status=status,
+            latency_seconds=round(time.perf_counter() - t0, 6),
+            trace_id=self._span.trace_id, span_id=self._span.span_id,
+            parent_id=self._span.parent_id,
+            cas_retries=trace.cas_retries(), retries=retries))
+
     def _route_inner(self, method: str):
         url = urlparse(self.path)
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
@@ -300,9 +385,12 @@ class _Handler(BaseHTTPRequestHandler):
             # health probes stay unauthenticated (reference serves /healthz on
             # the insecure port for liveness checks)
             return self._send_plain(200, b"ok")
-        if url.path in ("/version", "/metrics", "/api", "/apis"):
+        if url.path in ("/version", "/metrics", "/api", "/apis", "/auditz"):
             if not self._auth_nonresource(url.path):
                 return
+        if url.path == "/auditz":
+            # tail of the audit ring (newest last); ?n= bounds the slice
+            return self._send_json(200, render_auditz(AUDIT, q.get("n")))
         if url.path == "/version":
             return self._send_json(200, {"major": "0", "minor": "1",
                                          "gitVersion": "kubernetes-tpu-0.1"})
@@ -579,6 +667,7 @@ class _Handler(BaseHTTPRequestHandler):
             meta.namespace = meta.namespace or ns
 
     def _send_plain(self, code: int, body: bytes):
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", "text/plain")
         self.send_header("Content-Length", str(len(body)))
@@ -662,6 +751,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # in lockstep and exhaust any fixed retry budget
                 import random
                 import time as _time
+                trace.note_cas_retry()  # audited: how contended this PATCH was
                 _time.sleep(random.uniform(0, 0.002 * min(attempt, 10)))
             current = self.registry.get(resource, name, ns)
             merged = merge(codec.encode(current), patch)
@@ -703,6 +793,11 @@ class _Handler(BaseHTTPRequestHandler):
         rd = RESOURCES[resource]
         binary = self._wants_binary()
         METRICS.inc("apiserver_watch_streams", resource=resource)
+        self._status = 200
+        # audit the stream at OPEN (latency = time-to-accept): a watch can
+        # live for hours and its audit record must not wait for that
+        self._audited = True
+        self._emit_audit("GET", 200, self._t0)
         self.send_response(200)
         self.send_header("Content-Type",
                          binary_codec.CONTENT_TYPE if binary

@@ -20,6 +20,8 @@ import time
 from typing import Callable, List, Optional
 
 from kubernetes_tpu.client.rest import ApiError, RESTClient
+from kubernetes_tpu.utils import trace
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
 
 class ChaosConnectionReset(ConnectionResetError):
@@ -177,6 +179,17 @@ class ChaosController:
             if iv is not None:
                 with self._lock:
                     self.interventions.append((iv.source, method, path))
+                # the observatory's view of injected faults: a counter the
+                # scraper/SLO layer can read, and a stamp on the active
+                # span so a burning SLO window is attributable to injected
+                # vs. real faults from the trace alone
+                METRICS.inc("rest_client_chaos_interventions_total",
+                            kind=iv.source)
+                sp = trace.current_span()
+                if sp is not None:
+                    sp.attrs["chaos_intervention"] = iv.source
+                    sp.attrs["chaos_interventions"] = \
+                        sp.attrs.get("chaos_interventions", 0) + 1
                 return iv
         return None
 

@@ -15,6 +15,7 @@ import time
 from typing import Callable, Optional
 
 from kubernetes_tpu.client.rest import ApiError, RESTClient
+from kubernetes_tpu.utils.trace import Span, use_span
 
 log = logging.getLogger("reflector")
 
@@ -90,12 +91,34 @@ class Reflector:
     # --- the pump (ListAndWatch, reflector.go:252) ---------------------------
 
     def _loop(self):
-        while not self._stop.is_set():
-            try:
-                self._list_and_watch()
-            except Exception as e:
-                log.warning("%s: list/watch failed: %s; backing off", self.name, e)
-                self._stop.wait(self.relist_backoff)
+        # one "sync chain" span per list-and-watch attempt CHAIN: retries of
+        # a failing LIST reuse the same span (one trace id across the whole
+        # retry storm, retry ordinal in attrs — rest.py forwards both, so
+        # the apiserver audit log shows "attempt N of trace T"), and a chain
+        # that syncs cleanly finishes its span and the next relist starts a
+        # fresh trace.
+        chain: Optional[Span] = None
+        failures = 0
+        try:
+            while not self._stop.is_set():
+                if chain is None:
+                    chain = Span("reflector_sync", resource=self.lw.resource,
+                                 reflector=self.name)
+                    failures = 0
+                try:
+                    with use_span(chain):
+                        self._list_and_watch()
+                    chain.finish()
+                    chain = None
+                except Exception as e:
+                    failures += 1
+                    chain.attrs["retries"] = failures
+                    log.warning("%s: list/watch failed: %s; backing off",
+                                self.name, e)
+                    self._stop.wait(self.relist_backoff)
+        finally:
+            if chain is not None:
+                chain.finish()
 
     def _list_and_watch(self):
         items, rv = self.lw.list()
@@ -111,6 +134,13 @@ class Reflector:
                     return
                 raise
             self._active_watch = stream
+            if self._stop.is_set():
+                # stop() raced the watch open: it read _active_watch as None
+                # while we were inside lw.watch(), so nobody will stop this
+                # stream for us — without this check the pump parks in
+                # readline until the server's next heartbeat (30s)
+                stream.stop()
+                return
             try:
                 for etype, obj in stream:
                     if self._stop.is_set():

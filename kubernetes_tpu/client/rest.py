@@ -19,6 +19,7 @@ from kubernetes_tpu.api import binary_codec
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.serialization import from_dict, scheme, to_dict
 from kubernetes_tpu.registry.generic import RESOURCES
+from kubernetes_tpu.utils import trace
 from kubernetes_tpu.utils.flowcontrol import TokenBucket
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 
@@ -224,10 +225,47 @@ class RESTClient:
 
     def request(self, method: str, path: str, body: Optional[dict] = None,
                 content_type: Optional[str] = None) -> dict:
+        # cross-process tracing: inside a traced context (a scheduler bind
+        # span, a reflector relist chain) every request gets its own child
+        # span, and _request_once stamps that span's traceparent into the
+        # headers — the apiserver's request span and audit record then share
+        # the caller's trace id. Untraced requests skip the span (the server
+        # still mints a root trace for its audit record).
+        parent = trace.current_span()
+        if parent is None:
+            return self._request_with_retries(method, path, body,
+                                              content_type=content_type)
+        sp = trace.Span(f"rest:{method}", parent=parent, path=path,
+                        component=self.user_agent)
+        # carry the caller's accumulated retry count (a reflector relist
+        # chain counts its failed attempts on the chain span) so the server
+        # can audit "this was attempt N of a retry storm"
+        base = parent.attrs.get("retries", 0)
+        if base:
+            sp.attrs["retries"] = base
+        try:
+            # _request_once stamps the real HTTP status onto the span; the
+            # ApiError arm covers chaos interventions that short-circuit
+            # before any wire response exists
+            with trace.use_span(sp):
+                return self._request_with_retries(
+                    method, path, body, content_type=content_type)
+        except ApiError as e:
+            sp.attrs["status"] = e.code
+            raise
+        finally:
+            sp.finish()
+
+    def _request_with_retries(self, method: str, path: str,
+                              body: Optional[dict] = None,
+                              content_type: Optional[str] = None) -> dict:
         # 429 = server-side max-in-flight shed the request before executing
         # it: always safe to retry after a short backoff (the reference
         # client honors Retry-After the same way)
-        for backoff in (0.1, 0.4, 1.0, 2.0, None):
+        sp = trace.current_span()
+        for attempt, backoff in enumerate((0.1, 0.4, 1.0, 2.0, None)):
+            if sp is not None and attempt:
+                sp.attrs["retries"] = sp.attrs.get("retries", 0) + 1
             parsed = self._request_once(method, path, body,
                                         content_type=content_type)
             if parsed.get("code") == 429 and backoff is not None:
@@ -259,6 +297,7 @@ class RESTClient:
         if payload is not None:
             headers["Content-Type"] = content_type or self.content_type
         self._auth_headers(headers)
+        self._trace_headers(headers)
         for attempt in (1, 2):
             conn = self._conn()
             try:
@@ -281,6 +320,9 @@ class RESTClient:
                 if method == "GET" and attempt == 1:
                     continue
                 raise
+        sp = trace.current_span()
+        if sp is not None:
+            sp.attrs["status"] = resp.status  # the wire truth, 201 included
         if not data:
             parsed = {}
         elif binary_codec.is_binary(data):
@@ -296,6 +338,18 @@ class RESTClient:
             raise ApiError(resp.status, parsed.get("reason", "Unknown"),
                            parsed.get("message", ""))
         return parsed
+
+    @staticmethod
+    def _trace_headers(headers: dict) -> None:
+        """Stamp the current span's traceparent (and retry ordinal) into the
+        outgoing headers — the cross-process half of utils/trace.py."""
+        sp = trace.current_span()
+        if sp is None:
+            return
+        headers[trace.TRACEPARENT_HEADER] = trace.format_traceparent(sp)
+        retries = sp.attrs.get("retries", 0)
+        if retries:
+            headers[trace.RETRY_HEADER] = str(int(retries))
 
     def _auth_headers(self, headers: dict) -> None:
         if self.bearer_token:
@@ -430,6 +484,7 @@ class RESTClient:
         if binary:
             headers["Accept"] = binary_codec.CONTENT_TYPE
         self._auth_headers(headers)
+        self._trace_headers(headers)
         conn.request("GET", path, headers=headers)
         resp = conn.getresponse()
         if resp.status >= 400:

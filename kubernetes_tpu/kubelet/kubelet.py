@@ -29,6 +29,7 @@ from kubernetes_tpu.scheduler.cache import NodeInfo
 from kubernetes_tpu.scheduler.predicates import PredicateFailure, general_predicates
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 from kubernetes_tpu.utils.timeutil import now_iso, parse_iso
+from kubernetes_tpu.utils.trace import Span, use_span
 
 log = logging.getLogger("kubelet")
 
@@ -125,8 +126,13 @@ class Kubelet:
         the eviction tick's prompt heartbeat must not lose its fresh
         MemoryPressure flip to the periodic thread's concurrent
         read-modify-write."""
-        with self._heartbeat_lock:
-            self._heartbeat_locked()
+        sp = Span("kubelet_heartbeat", node=self.node_name)
+        try:
+            with use_span(sp):
+                with self._heartbeat_lock:
+                    self._heartbeat_locked()
+        finally:
+            sp.finish()
 
     def _heartbeat_locked(self):
         try:
@@ -172,7 +178,15 @@ class Kubelet:
         # runs inline on the informer dispatch thread: events for a pod are
         # applied in order (the reference serializes via per-pod podWorkers;
         # a thread-per-event here let a stale update resurrect a killed pod)
-        self._sync_pod(pod)
+        sp = Span("kubelet_sync_pod", node=self.node_name,
+                  pod=f"{pod.metadata.namespace}/{pod.metadata.name}")
+        try:
+            # sync under the span: the status PATCHes and Event posts this
+            # sync issues share its trace id through the apiserver audit log
+            with use_span(sp):
+                self._sync_pod(pod)
+        finally:
+            sp.finish()
 
     def _sync_pod(self, pod: api.Pod):
         """syncPod: admit -> run -> report (kubelet.go:1796)."""

@@ -8,8 +8,19 @@
   with scraped steady-state SLIs) behind `bench.py --mode soak`.
 - `profiling`: jax.profiler hooks — the always-on host/device time split
   (`scheduler_kernel_device_seconds`) and the `/profilez` trace windows.
+- `audit`: the apiserver's structured per-request audit log (ring +
+  rotating disk sink), served at `/auditz`.
+- `flightrecorder`: the black box — spans/Events/audit/metric-delta rings
+  dumped as one forensic JSON bundle on stage timeouts, wedged soaks, and
+  SLO burn transitions.
 """
 
+from kubernetes_tpu.observability.audit import (  # noqa: F401
+    AUDIT, AuditLog, AuditRecord,
+)
+from kubernetes_tpu.observability.flightrecorder import (  # noqa: F401
+    RECORDER, FlightRecorder,
+)
 from kubernetes_tpu.observability.scrape import (  # noqa: F401
     Family, HistogramSnapshot, Scraper, parse_prometheus_text,
 )

@@ -1,0 +1,200 @@
+"""Structured apiserver audit log.
+
+Parity target: the reference's apiserver audit trail (--audit-log-path with
+maxsize/maxbackup rotation, pkg/apiserver audit handler): one structured
+record per completed request — verb, path, requesting component
+(user-agent) and authenticated user, response status, latency, the trace id
+propagated from the client's `traceparent` header, the storage CAS-retry
+count the request burned, and the client-reported retry ordinal.
+
+Two sinks, both bounded:
+
+- an in-memory ring (`tail`) — what `/auditz` serves and what the flight
+  recorder folds into forensic bundles;
+- an optional JSON-lines file with size-based rotation (`path.1`..`path.N`
+  backups), enabled via `AuditLog.open()` / the `KTPU_AUDIT_LOG` env var —
+  the on-disk trail that survives the process.
+
+`AUDIT` is the process-wide singleton, mirroring the metrics REGISTRY: the
+apiserver writes it, every component's debug mux can serve it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections import deque
+from dataclasses import asdict, dataclass
+from typing import List, Optional
+
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+from kubernetes_tpu.utils.timeutil import now_iso as _now_iso
+
+log = logging.getLogger("audit")
+
+DEFAULT_CAPACITY = 4096
+DEFAULT_MAX_BYTES = 8 * 1024 * 1024
+DEFAULT_BACKUPS = 3
+
+
+@dataclass
+class AuditRecord:
+    ts: str
+    verb: str
+    path: str
+    component: str = ""      # client User-Agent (one logical client each)
+    user: str = ""           # authenticated identity, "" on the insecure port
+    status: int = 0          # 0 = connection died before a response was sent
+    latency_seconds: float = 0.0
+    trace_id: str = ""       # from the client traceparent, or server-minted
+    span_id: str = ""        # the server-side request span
+    parent_id: str = ""      # the client span that issued the request
+    cas_retries: int = 0     # storage CAS conflicts burned serving this
+    retries: int = 0         # client-side retry ordinal (x-ktpu-retries)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+class AuditLog:
+    """Bounded ring + optional rotating JSON-lines file."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 path: str = "", max_bytes: int = DEFAULT_MAX_BYTES,
+                 backups: int = DEFAULT_BACKUPS):
+        self._lock = threading.Lock()
+        self._ring: "deque[AuditRecord]" = deque(maxlen=capacity)
+        self._fh = None
+        self._path = ""
+        self._size = 0
+        self._max_bytes = max_bytes
+        self._backups = backups
+        path = path or os.environ.get("KTPU_AUDIT_LOG", "")
+        if path:
+            self.open(path, max_bytes=max_bytes, backups=backups)
+
+    # --- disk sink -----------------------------------------------------------
+
+    def open(self, path: str, max_bytes: int = DEFAULT_MAX_BYTES,
+             backups: int = DEFAULT_BACKUPS) -> "AuditLog":
+        """Attach (or re-point) the rotating on-disk sink."""
+        with self._lock:
+            self._close_locked()
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._path = path
+            self._max_bytes = max_bytes
+            self._backups = backups
+            self._fh = open(path, "a", encoding="utf-8")
+            self._size = self._fh.tell()
+        return self
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    def close_if(self, path: str) -> None:
+        """Close the disk sink only if it still points at `path` — the
+        owner-release used by APIServer.stop(), which must not yank a sink
+        a newer server has since re-pointed elsewhere."""
+        with self._lock:
+            if self._path == path:
+                self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                log.warning("audit log close failed for %s", self._path)
+            self._fh = None
+            self._path = ""
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        # shift path.N-1 -> path.N ... path -> path.1; the oldest falls off
+        for i in range(self._backups - 1, 0, -1):
+            src, dst = f"{self._path}.{i}", f"{self._path}.{i + 1}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        if self._backups > 0:
+            os.replace(self._path, f"{self._path}.1")
+            mode = "a"
+        else:
+            # no backups: truncate in place — max_bytes must still bound
+            # the trail, not silently stop applying
+            mode = "w"
+        self._fh = open(self._path, mode, encoding="utf-8")
+        self._size = 0
+
+    # --- recording -----------------------------------------------------------
+
+    def record(self, rec: AuditRecord) -> None:
+        # serialize OUTSIDE the lock: every apiserver handler thread funnels
+        # through here, and json.dumps under the lock would make the audit
+        # trail a global serialization point (unlocked _fh peek is benign —
+        # re-checked under the lock before writing)
+        line = (json.dumps(rec.to_dict(), separators=(",", ":"))
+                if self._fh is not None else None)
+        with self._lock:
+            self._ring.append(rec)
+            if self._fh is not None and line is not None:
+                try:
+                    self._fh.write(line + "\n")
+                    self._fh.flush()
+                    self._size += len(line) + 1
+                    if self._size >= self._max_bytes:
+                        self._rotate_locked()
+                except OSError:
+                    # the ring is the primary sink; a full disk must not
+                    # turn every API request into a 500
+                    log.warning("audit disk write failed for %s", self._path)
+        METRICS.inc("apiserver_audit_records_total", verb=rec.verb)
+
+    # --- reads ---------------------------------------------------------------
+
+    def tail(self, n: int = 256, verb: Optional[str] = None,
+             path_contains: Optional[str] = None,
+             trace_id: Optional[str] = None) -> List[AuditRecord]:
+        """Newest-last slice of the ring, optionally filtered. n <= 0 is
+        empty — out[-0:] would silently mean "everything"."""
+        if n <= 0:
+            return []
+        with self._lock:
+            out = list(self._ring)
+        if verb is not None:
+            out = [r for r in out if r.verb == verb]
+        if path_contains is not None:
+            out = [r for r in out if path_contains in r.path]
+        if trace_id is not None:
+            out = [r for r in out if r.trace_id == trace_id]
+        return out[-n:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+def render_auditz(audit: AuditLog, n=256) -> dict:
+    """JSON payload for the /auditz debug endpoint (newest last). `n` may
+    be the raw query-string value — both the apiserver route and the debug
+    mux hand it over untouched, so the parse lives in exactly one place."""
+    try:
+        n = int(n)
+    except (TypeError, ValueError):
+        n = 256
+    records = audit.tail(n)
+    return {"count": len(audit), "returned": len(records),
+            "records": [r.to_dict() for r in records]}
+
+
+def now_iso() -> str:
+    return _now_iso()
+
+
+AUDIT = AuditLog()

@@ -1,0 +1,204 @@
+"""Black-box flight recorder: dump the last N seconds of everything.
+
+The observatory (scrape -> SLO -> soak) DETECTS failure; this module makes
+failure EXPLAINABLE from artifacts alone.  It continuously rides on the
+bounded rings the rest of the system already maintains — finished spans
+(`utils/trace.recent_spans`), locally emitted Events
+(`utils/events.recent_events`), apiserver audit records
+(`observability/audit.AUDIT`) — plus its own notes ring (soak rounds,
+metric deltas), and on a trigger serializes all of them into ONE forensic
+JSON bundle:
+
+- a kernel stage watchdog fires (`ops/watchdog.run_stages`),
+- a soak run goes ``wedged: true`` (`observability/soak.py`),
+- an SLO transitions to burning (`observability/slo.SLOEngine`).
+
+Bundles are bounded on disk (`keep` newest survive) and rate-limited per
+reason (`min_interval`) so a hang that fires every batch produces a handful
+of bundles, not thousands; the triggers that must attach a path to a report
+pass ``force=True``.  `bench.py` embeds the bundle path in its JSON so a
+wedged BENCH round is diagnosable without re-running anything.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.observability.audit import AUDIT
+from kubernetes_tpu.utils import trace
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+from kubernetes_tpu.utils.timeutil import now_iso as _now_iso
+
+log = logging.getLogger("flightrecorder")
+
+BUNDLE_KIND = "ktpu-flight-recorder-bundle"
+BUNDLE_VERSION = 1
+
+# counter families whose per-label series are broken out in full (beyond the
+# family totals) — the ones a wedge postmortem reads first
+_FOCUS_COUNTERS = (
+    "scheduler_stage_timeout_total",
+    "soak_phase_timeout_total",
+    "slo_violations_total",
+    "rest_client_chaos_interventions_total",
+    "apiserver_dropped_requests",
+    "flight_recorder_dumps_total",
+)
+
+
+def _span_dict(span) -> dict:
+    return {
+        "name": span.name,
+        "trace_id": span.trace_id,
+        "span_id": span.span_id,
+        "parent_id": span.parent_id,
+        "duration_seconds": round(span.duration, 6),
+        "attrs": dict(span.attrs),
+    }
+
+
+def _slug(reason: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "-" for c in reason)[:48]
+
+
+class FlightRecorder:
+    def __init__(self, directory: str = "", keep: int = 8,
+                 min_interval: float = 5.0, notes_capacity: int = 512):
+        self._lock = threading.Lock()
+        self._notes: "deque[dict]" = deque(maxlen=notes_capacity)
+        self._last_counter_totals: Dict[str, float] = {}
+        self._last_dump_by_reason: Dict[str, float] = {}
+        self._seq = 0
+        self.keep = keep
+        self.min_interval = min_interval
+        # per-pid default dir: concurrent processes (verify.sh soak smokes,
+        # the bench restart probe) must not prune each other's bundles
+        self.directory = (directory
+                          or os.environ.get("KTPU_FLIGHT_DIR")
+                          or os.path.join(tempfile.gettempdir(),
+                                          f"ktpu-flight-{os.getpid()}"))
+
+    # --- continuous inputs ---------------------------------------------------
+
+    def note(self, kind: str, **payload) -> None:
+        """Append one entry to the notes ring (soak rounds, SLO verdicts —
+        anything a postmortem wants timestamped next to spans and audit)."""
+        with self._lock:
+            self._notes.append({"ts": _now_iso(), "kind": kind, **payload})
+
+    def snapshot_metrics(self) -> dict:
+        """Record the counter movement since the previous snapshot as a
+        metric-delta note; returns the delta dict."""
+        totals = METRICS.counter_totals()
+        with self._lock:
+            prev = self._last_counter_totals
+            delta = {name: v - prev.get(name, 0.0)
+                     for name, v in totals.items()
+                     if v - prev.get(name, 0.0)}
+            self._last_counter_totals = totals
+            self._notes.append({"ts": _now_iso(), "kind": "metrics_delta",
+                                "delta": delta})
+        return delta
+
+    # --- the dump ------------------------------------------------------------
+
+    def dump(self, reason: str, trigger: Optional[dict] = None,
+             force: bool = True) -> Optional[str]:
+        """Write a forensic bundle; returns its path, or None when the
+        same reason dumped within `min_interval` and force is False."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_dump_by_reason.get(reason)
+            if (not force and last is not None
+                    and now - last < self.min_interval):
+                return None
+            self._last_dump_by_reason[reason] = now
+            self._seq += 1
+            seq = self._seq
+            notes = list(self._notes)
+        from kubernetes_tpu.utils.events import recent_events
+        counters = METRICS.counter_totals()
+        # span selection: the newest 512, PLUS every timed-out stage span
+        # still in the ring regardless of age — at realistic churn the
+        # wedge cause fires early and thousands of later spans would push
+        # it out of a plain tail, gutting the bundle's whole point. The
+        # truncation is recorded, never silent.
+        all_spans = trace.recent_spans()
+        tail = all_spans[-512:]
+        keep = {id(s) for s in tail}
+        timed_out = [s for s in all_spans
+                     if s.attrs.get("timeout") and id(s) not in keep]
+        bundle = {
+            "kind": BUNDLE_KIND,
+            "version": BUNDLE_VERSION,
+            "reason": reason,
+            "trigger": trigger or {},
+            "ts": _now_iso(),
+            "pid": os.getpid(),
+            "spans_total_in_ring": len(all_spans),
+            "spans_truncated": len(all_spans) > len(tail),
+            "spans": [_span_dict(s) for s in timed_out + tail],
+            "events": recent_events(256),
+            "audit": [r.to_dict() for r in AUDIT.tail(512)],
+            "notes": notes,
+            "metrics": {
+                "counters": counters,
+                "series": {
+                    name: [{**dict(lk), "value": v}
+                           for lk, v in series.items()]
+                    for name, series in
+                    ((n, METRICS.counter_series(n)) for n in _FOCUS_COUNTERS)
+                    if series
+                },
+            },
+        }
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            fname = f"flight-{int(time.time())}-{seq:04d}-{_slug(reason)}.json"
+            path = os.path.join(self.directory, fname)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                # default=repr: span attrs may carry exceptions or other
+                # non-JSON values; a bundle must never fail to serialize
+                json.dump(bundle, fh, default=repr)
+            os.replace(tmp, path)
+            self._prune()
+        except OSError:
+            log.exception("flight recorder dump failed (reason=%s)", reason)
+            return None
+        METRICS.inc("flight_recorder_dumps_total", reason=_slug(reason))
+        log.warning("flight recorder bundle written: %s (reason=%s)",
+                    path, reason)
+        return path
+
+    def _prune(self) -> None:
+        try:
+            bundles = sorted(
+                f for f in os.listdir(self.directory)
+                if f.startswith("flight-") and f.endswith(".json"))
+        except OSError:
+            return
+        for stale in bundles[:-self.keep] if self.keep > 0 else bundles:
+            try:
+                os.remove(os.path.join(self.directory, stale))
+            except OSError:
+                log.warning("could not prune stale bundle %s", stale)
+
+    def bundles(self) -> List[str]:
+        """Existing bundle paths, oldest first."""
+        try:
+            return [os.path.join(self.directory, f)
+                    for f in sorted(os.listdir(self.directory))
+                    if f.startswith("flight-") and f.endswith(".json")]
+        except OSError:
+            return []
+
+
+RECORDER = FlightRecorder()

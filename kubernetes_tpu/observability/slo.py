@@ -186,8 +186,35 @@ class SLOEngine:
                               slo=spec.name, verdict=res.verdict)
             if res.verdict == VERDICT_BURNING:
                 self.registry.inc("slo_violations_total", slo=spec.name)
+                if spec.name not in self._open_violations:
+                    # burn TRANSITION (not every round of a sustained burn):
+                    # black-box the moment it started — the bundle carries
+                    # the spans/audit/rounds leading into the violation
+                    self._flight_dump(spec, res)
             self._post_event(spec, res)
+            if res.verdict == VERDICT_BURNING:
+                self._open_violations.add(spec.name)
+            elif res.verdict == VERDICT_OK:
+                # a no_data gap in between must not leave the violation
+                # dangling forever once the SLI provably recovered
+                self._open_violations.discard(spec.name)
         return results
+
+    @staticmethod
+    def _flight_dump(spec: SLOSpec, res: SLOResult):
+        try:
+            # lazy import: flightrecorder depends on the audit module; the
+            # SLO engine must stay usable without the apiserver half loaded
+            from kubernetes_tpu.observability.flightrecorder import RECORDER
+            RECORDER.dump(f"slo-burn-{spec.name}", force=False,
+                          trigger={"slo": spec.name,
+                                   "objective": spec.describe(),
+                                   "windows": [w.as_dict()
+                                               for w in res.windows]})
+        except Exception:
+            import logging
+            logging.getLogger("slo").exception(
+                "flight-recorder dump failed for burning SLO %s", spec.name)
 
     def _post_event(self, spec: SLOSpec, res: SLOResult):
         if self.recorder is None:
@@ -198,13 +225,9 @@ class SLOEngine:
             # that must read as "inf", not filter away to a garbled "nan")
             worst = max((w.burn for w in res.windows
                          if not math.isnan(w.burn)), default=float("nan"))
-            self._open_violations.add(spec.name)
             self.recorder.event(
                 obj, "Warning", "SLOViolation",
                 f"{spec.describe()} burning at {worst:.2f}x budget")
         elif res.verdict == VERDICT_OK and spec.name in self._open_violations:
-            # a no_data gap in between must not leave the violation
-            # dangling forever once the SLI provably recovered
-            self._open_violations.discard(spec.name)
             self.recorder.event(obj, "Normal", "SLORecovered",
                                 f"{spec.describe()} back inside objective")

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from kubernetes_tpu.ops import watchdog
+from kubernetes_tpu.observability.flightrecorder import RECORDER
 from kubernetes_tpu.observability.scrape import Scraper
 from kubernetes_tpu.observability.slo import SLOEngine, SLOSpec, Window
 from kubernetes_tpu.utils.metrics import finite_round
@@ -189,13 +190,32 @@ def run_soak(cfg: SoakConfig, scraper: Optional[Scraper] = None) -> dict:
         report["error"] = str(e)
         from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
         METRICS.inc("soak_phase_timeout_total", phase=e.stage)
+        _attach_bundle(report, "soak-phase-timeout",
+                       {"phase": e.stage, "error": str(e)})
     except Exception as e:
         state["abandoned"] = True
         report["error"] = repr(e)
         report["wedged"] = True
+        _attach_bundle(report, "soak-error", {"error": repr(e)})
     finally:
         _teardown(state)
     return report
+
+
+def _attach_bundle(report: dict, reason: str, trigger: dict) -> None:
+    """Dump a forensic bundle for a wedged/errored soak and put its path in
+    the report — the artifact the next postmortem starts from. Best-effort:
+    a failed dump must not mask the wedge verdict itself."""
+    trigger = dict(trigger)
+    trigger["slos"] = report.get("slos") or (
+        report["rounds"][-1].get("slos") if report.get("rounds") else None)
+    try:
+        path = RECORDER.dump(reason, trigger=trigger)
+    except Exception:
+        log.exception("flight-recorder dump failed for wedged soak")
+        return
+    if path is not None:
+        report["flight_recorder_bundle"] = path
 
 
 class SoakAbandoned(RuntimeError):
@@ -330,6 +350,12 @@ def _record_round(cfg: SoakConfig, state: dict, report: dict,
             "scheduler", "informer_watch_lag_seconds", resource="pods")),
         "slos": {r.name: r.verdict for r in engine.evaluate()},
     })
+    rnd = report["rounds"][-1]
+    # black-box feed: every scraped round (and its counter movement) lands
+    # in the flight recorder's notes ring, so a bundle dumped mid-wedge
+    # shows the rounds leading INTO it, not just the final state
+    RECORDER.note("soak_round", round=rnd)
+    RECORDER.snapshot_metrics()
     if len(report["rounds"]) == cfg.warmup_rounds:
         # warmup over: the steady-state aggregate starts at THIS scrape
         last = scr.last("scheduler")
@@ -421,6 +447,10 @@ def _finalize(cfg: SoakConfig, state: dict, report: dict) -> None:
     if fired:
         out["wedged"] = True
         out["stage_timeouts"] = fired
+        # the forensic bundle IS the acceptance artifact for a wedged soak:
+        # the timed-out stage's span, the audit records around it, and the
+        # SLO verdicts, one JSON file whose path rides in the report
+        _attach_bundle(out, "soak-wedged", {"stage_timeouts": fired})
     # single merge, re-checking abandonment right before it: if the report
     # phase itself blew its deadline, the caller already returned `report`
     # — this thread must not mutate it mid-serialization

@@ -75,7 +75,7 @@ def run_stages(work: Callable, deadlines: Optional[Dict[str, float]] = None,
     With `span` given, each stage also becomes a child span of it.
     """
     deadlines = deadlines or {}
-    state = {"stage": None, "since": 0.0}
+    state = {"stage": None, "since": 0.0, "child": None}
     state_lock = threading.Lock()
     done = threading.Event()
     box: dict = {}
@@ -89,6 +89,7 @@ def run_stages(work: Callable, deadlines: Optional[Dict[str, float]] = None,
         with state_lock:
             state["stage"] = name
             state["since"] = time.monotonic()
+            state["child"] = child
         t0 = time.perf_counter()
         try:
             with annotate(f"ktpu:{name}"):
@@ -101,6 +102,7 @@ def run_stages(work: Callable, deadlines: Optional[Dict[str, float]] = None,
                 child.finish()
             with state_lock:
                 state["stage"] = None
+                state["child"] = None
 
     def runner():
         try:
@@ -126,6 +128,28 @@ def run_stages(work: Callable, deadlines: Optional[Dict[str, float]] = None,
                 registry.inc(TIMEOUT_METRIC, stage=name)
             if span is not None:
                 span.attrs["timeout_stage"] = name
+            with state_lock:
+                child = state["child"]
+            if child is not None and child.name == name:
+                # the abandoned worker will never run the stage's finally:
+                # close its span HERE (finish is first-write-wins, so a
+                # later unblocked worker's finish is a no-op) so the
+                # timed-out stage is visible in the recent-spans ring and
+                # any flight-recorder bundle
+                child.attrs["timeout"] = True
+                child.finish()
+            try:
+                # lazy import: ops must stay importable without pulling the
+                # observability package in at module-import time
+                from kubernetes_tpu.observability.flightrecorder import (
+                    RECORDER,
+                )
+                RECORDER.dump("stage-timeout", force=False,
+                              trigger={"stage": name, "deadline": limit})
+            except Exception:
+                import logging
+                logging.getLogger("watchdog").exception(
+                    "flight recorder dump failed on stage timeout")
             raise StageTimeout(name, limit)
     if "err" in box:
         raise box["err"]

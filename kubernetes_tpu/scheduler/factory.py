@@ -40,7 +40,7 @@ from kubernetes_tpu.scheduler.provider import (
 from kubernetes_tpu.utils.flowcontrol import Backoff
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 from kubernetes_tpu.utils.timeutil import parse_iso
-from kubernetes_tpu.utils.trace import SpanTracker
+from kubernetes_tpu.utils.trace import SpanTracker, use_span
 
 log = logging.getLogger("scheduler")
 
@@ -278,14 +278,17 @@ class Scheduler:
 
     def _bind(self, pod: api.Pod, dest: str, t_start: float, did_assume: bool):
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
-        self.f.spans.stage(key, "bind", node=dest)
+        bind_span = self.f.spans.stage(key, "bind", node=dest)
         binding = api.Binding(
             metadata=api.ObjectMeta(name=pod.metadata.name,
                                     namespace=pod.metadata.namespace),
             target=api.ObjectReference(kind="Node", name=dest))
         try:
             with METRICS.time("scheduler_binding_latency_seconds"):
-                self.f.client.bind(binding, pod.metadata.namespace)
+                # the bind POST travels with the pod's trace: the apiserver
+                # request span + audit record share this pod's trace id
+                with use_span(bind_span):
+                    self.f.client.bind(binding, pod.metadata.namespace)
         except Exception as e:
             # transport errors too — a dead bind thread with no rollback
             # would strand the pod booked-but-unbound until TTL expiry
@@ -306,14 +309,17 @@ class Scheduler:
         """Error func: event + condition + backoff requeue
         (scheduler.go:102-107, factory.go:503-539)."""
         log.info("failed to schedule %s: %s", pod.metadata.name, err)
-        self.f.spans.finish(f"{pod.metadata.namespace}/{pod.metadata.name}",
-                            error=str(err))
+        root = self.f.spans.finish(
+            f"{pod.metadata.namespace}/{pod.metadata.name}", error=str(err))
         self.recorder.event(pod, "Warning", "FailedScheduling", str(err))
         try:
-            self.f.client.request(
-                "PUT",
-                f"/api/v1/namespaces/{pod.metadata.namespace}/pods/{pod.metadata.name}/status",
-                _status_with_condition(pod, "Unschedulable", str(err)))
+            # status write under the pod's (just-finished) span: the audit
+            # trail ties the Unschedulable PUT to the failed attempt's trace
+            with use_span(root):
+                self.f.client.request(
+                    "PUT",
+                    f"/api/v1/namespaces/{pod.metadata.namespace}/pods/{pod.metadata.name}/status",
+                    _status_with_condition(pod, "Unschedulable", str(err)))
         except ApiError:
             pass
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"

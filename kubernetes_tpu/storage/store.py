@@ -231,6 +231,11 @@ class MemStore:
                 new_rv = self.update(key, new, expect_rv=rv)
                 return _copy(new), new_rv
             except Conflict:
+                # request-scoped CAS accounting: the apiserver's audit
+                # record reports how contended this write was (lazy import —
+                # the storage layer stays importable standalone)
+                from kubernetes_tpu.utils.trace import note_cas_retry
+                note_cas_retry()
                 continue
         raise Conflict(f"{key}: too much contention")
 

@@ -60,6 +60,13 @@ def debug_route(path: str, healthz: Callable[[], bool],
     if path == "/configz":
         return (200, json.dumps(render_configz(configz)).encode(),
                 "application/json")
+    if path == "/auditz":
+        # tail of the process-wide audit ring (the apiserver writes it;
+        # every component's mux can serve it, mirroring /metrics)
+        from kubernetes_tpu.observability.audit import AUDIT, render_auditz
+        n = (query.get("n") or [None])[0]
+        return (200, json.dumps(render_auditz(AUDIT, n)).encode(),
+                "application/json")
     return None
 
 

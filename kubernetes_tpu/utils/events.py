@@ -28,7 +28,7 @@ import logging
 import queue
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Optional, Tuple
 
 from kubernetes_tpu.api import types as api
@@ -52,6 +52,27 @@ DEFAULT_SPAM_BURST = 25
 DEFAULT_SPAM_QPS = 1.0 / 300.0
 
 AGGREGATED_PREFIX = "(combined from similar events): "
+
+# local black-box ring of emitted events (post-correlation), independent of
+# whether the API post succeeds — the flight recorder reads THIS, because a
+# wedged control plane is exactly when reading Events back via the API fails
+_RECENT_EVENTS: "deque[dict]" = deque(maxlen=1024)
+_RECENT_EVENTS_LOCK = threading.Lock()
+
+
+def _note_recent_event(component: str, kind: str, namespace: str, name: str,
+                       etype: str, reason: str, message: str) -> None:
+    with _RECENT_EVENTS_LOCK:
+        _RECENT_EVENTS.append({
+            "ts": _now_iso(), "component": component, "kind": kind,
+            "namespace": namespace, "name": name, "type": etype,
+            "reason": reason, "message": message})
+
+
+def recent_events(n: int = 256) -> list:
+    """Newest-last tail of locally emitted events (dicts, JSON-ready)."""
+    with _RECENT_EVENTS_LOCK:
+        return list(_RECENT_EVENTS)[-n:]
 
 
 class EventCorrelator:
@@ -173,6 +194,9 @@ class EventRecorder:
             return
         dedup_key, message, _aggregated = hit
         METRICS.inc("events_emitted_total", component=self.source.component)
+        _note_recent_event(self.source.component, ref.kind,
+                           ref.namespace or "", ref.name or "",
+                           etype, reason, message)
         ns = meta.namespace or "default"
         existing = self._seen.get(dedup_key)
         if existing is not None:

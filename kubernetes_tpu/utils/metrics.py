@@ -132,6 +132,13 @@ class MetricsRegistry:
         with self._lock:
             return dict(self._counters.get(name, {}))
 
+    def counter_totals(self) -> Dict[str, float]:
+        """Locked snapshot of every counter family summed across labels —
+        the flight recorder's delta baseline."""
+        with self._lock:
+            return {name: sum(series.values())
+                    for name, series in self._counters.items()}
+
     def hist_stats(self, name: str) -> Dict[Tuple, Tuple[int, float]]:
         """Locked snapshot of one histogram family:
         {label tuple: (observation count, sum of values)} — the source the

@@ -14,13 +14,22 @@ Two layers:
   one measurement, not two.  Finished spans land in a bounded ring
   (`recent_spans`) for tests and postmortems — the compact stand-in for a
   span exporter.
+- the cross-process layer: a contextvar holding the "current" span
+  (`use_span` / `current_span`), a W3C-style `traceparent` header carried
+  by `client/rest.py` and parsed by `apiserver/server.py`
+  (`format_traceparent` / `parse_traceparent`), and a request-scoped
+  CAS-retry counter (`note_cas_retry`) that `storage/store.py` ticks and
+  the apiserver's audit log reads — one trace id from a controller span
+  through its apiserver request span down to the storage retry loop.
 """
 
 from __future__ import annotations
 
+import contextvars
 import itertools
 import logging
 import os
+import re
 import threading
 import time
 from collections import OrderedDict, deque
@@ -35,8 +44,15 @@ _ID_PREFIX = os.urandom(4).hex()  # per-process uniqueness
 _ID_COUNTER = itertools.count(1)
 
 
-def new_id() -> str:
-    return f"{_ID_PREFIX}-{next(_ID_COUNTER):x}"
+def new_trace_id() -> str:
+    """32 lowercase hex chars (W3C trace-id shape): process prefix +
+    counter, so ids parse back out of a `traceparent` header unambiguously."""
+    return f"{_ID_PREFIX}{next(_ID_COUNTER):024x}"
+
+
+def new_span_id() -> str:
+    """16 lowercase hex chars (W3C parent-id shape)."""
+    return f"{_ID_PREFIX}{next(_ID_COUNTER):08x}"
 
 
 class Span:
@@ -48,11 +64,15 @@ class Span:
                  "attrs", "children")
 
     def __init__(self, name: str, trace_id: Optional[str] = None,
-                 parent: Optional["Span"] = None, **attrs):
+                 parent: Optional["Span"] = None, parent_id: str = "",
+                 **attrs):
         self.name = name
-        self.trace_id = trace_id or (parent.trace_id if parent else new_id())
-        self.span_id = new_id()
-        self.parent_id = parent.span_id if parent else ""
+        self.trace_id = trace_id or (parent.trace_id if parent
+                                     else new_trace_id())
+        self.span_id = new_span_id()
+        # `parent_id` covers the cross-process case: the remote parent is a
+        # header, not a Span object we could link children into
+        self.parent_id = parent.span_id if parent else parent_id
         self.start = time.perf_counter()
         self.end: Optional[float] = None
         self.attrs: Dict[str, object] = dict(attrs)
@@ -70,8 +90,15 @@ class Span:
 
     def finish(self, metric: Optional[str] = None, registry=None,
                **labels) -> float:
-        if self.end is None:
-            self.end = time.perf_counter()
+        # first-write-wins under a lock: the watchdog force-finishes a
+        # timed-out stage's span while the (no longer hung) worker may be
+        # racing its own finally-finish — without the lock both could pass
+        # the end-is-None check and double-record into the ring
+        with _FINISH_LOCK:
+            first = self.end is None
+            if first:
+                self.end = time.perf_counter()
+        if first:
             _record_span(self)
         d = self.end - self.start
         if metric:
@@ -102,6 +129,9 @@ class Span:
 # bounded exporter ring: tests and postmortems read finished spans here
 _RECENT: "deque[Span]" = deque(maxlen=4096)
 _RECENT_LOCK = threading.Lock()
+# serializes the end-stamp transition in Span.finish (distinct from
+# _RECENT_LOCK, which _record_span takes after the transition)
+_FINISH_LOCK = threading.Lock()
 
 
 def _record_span(span: Span):
@@ -123,6 +153,87 @@ def recent_spans(name: Optional[str] = None,
 def clear_recent():
     with _RECENT_LOCK:
         _RECENT.clear()
+
+
+def spans_for_trace(trace_id: str) -> List[Span]:
+    """Every finished span on one trace, oldest first — the per-trace
+    lookup tests and the flight recorder use instead of scanning the ring."""
+    with _RECENT_LOCK:
+        return [s for s in _RECENT if s.trace_id == trace_id]
+
+
+# --- cross-process context ----------------------------------------------------
+#
+# The current span rides a contextvar, NOT a threading.local: handler threads
+# are per-request, worker threads run one logical operation at a time, and a
+# contextvar composes with any future async port for free.  Threads do not
+# inherit it — a component handing work to another thread re-establishes the
+# context with `use_span(span)` around the calls it wants correlated (see
+# Scheduler._bind).
+
+_CURRENT: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "ktpu_current_span", default=None)
+
+TRACEPARENT_HEADER = "traceparent"
+RETRY_HEADER = "x-ktpu-retries"
+
+_TRACEPARENT = re.compile(
+    r"^00-([0-9a-f]{16,32})-([0-9a-f]{8,16})-([0-9a-f]{2})$")
+
+
+def current_span() -> Optional[Span]:
+    return _CURRENT.get()
+
+
+@contextmanager
+def use_span(span: Optional[Span]):
+    """Make `span` the current trace context for the duration of the block.
+    None is accepted and is a no-op, so call sites can pass an optional
+    span straight through without branching."""
+    if span is None:
+        yield None
+        return
+    token = _CURRENT.set(span)
+    try:
+        yield span
+    finally:
+        _CURRENT.reset(token)
+
+
+def format_traceparent(span: Span) -> str:
+    """W3C-style `00-<trace-id>-<span-id>-01` header value."""
+    return f"00-{span.trace_id}-{span.span_id}-01"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[Tuple[str, str]]:
+    """(trace_id, parent_span_id) from a traceparent header, or None for a
+    missing/garbled header — a bad header must degrade to "new trace",
+    never to a 400."""
+    if not value:
+        return None
+    m = _TRACEPARENT.match(value.strip())
+    if not m:
+        return None
+    return m.group(1), m.group(2)
+
+
+# request-scoped CAS-retry counter: the apiserver handler resets it per
+# request, storage's guaranteed_update and the PATCH retry loop tick it, and
+# the audit record reads the total — how contended this request's write was.
+_CAS_RETRIES: "contextvars.ContextVar[int]" = contextvars.ContextVar(
+    "ktpu_cas_retries", default=0)
+
+
+def reset_cas_retries() -> None:
+    _CAS_RETRIES.set(0)
+
+
+def note_cas_retry(n: int = 1) -> None:
+    _CAS_RETRIES.set(_CAS_RETRIES.get() + n)
+
+
+def cas_retries() -> int:
+    return _CAS_RETRIES.get()
 
 
 class SpanTracker:

@@ -46,7 +46,14 @@ force_cpu(device_count=8)
 def pytest_runtest_teardown(item, nextitem):
     """Fail the responsible test on any recorded race violation — raising
     inside a victim thread would vanish into a log; failing the test makes
-    the inversion/mutation a red X with the full report attached."""
+    the inversion/mutation a red X with the full report attached.
+
+    Also drains the finished-span ring between tests: span assertions
+    (recent_spans / spans_for_trace) must see only the test's own spans,
+    never a previous test's leftovers."""
+    from kubernetes_tpu.utils import trace as _trace
+
+    _trace.clear_recent()
     if not _RACE_DETECT:
         return
     violations = _race.drain_violations()

@@ -332,6 +332,83 @@ class TestHygiene:
 
 # --- suppressions & baseline --------------------------------------------------
 
+class TestLeakedSpan:
+    def test_straight_line_finish_caught(self):
+        src = """
+        from kubernetes_tpu.utils.trace import Span
+        def handle(self):
+            sp = Span("work")
+            self.do_things()
+            sp.finish()
+        """
+        assert findings_of(src, "leaked-span")
+
+    def test_never_finished_caught(self):
+        src = """
+        from kubernetes_tpu.utils.trace import Span
+        def handle(self):
+            sp = Span("work")
+            self.do_things()
+        """
+        assert findings_of(src, "leaked-span")
+
+    def test_bare_constructor_caught(self):
+        src = """
+        from kubernetes_tpu.utils.trace import Span
+        def handle(self):
+            Span("dropped")
+        """
+        assert findings_of(src, "leaked-span")
+
+    def test_finally_finish_passes(self):
+        src = """
+        from kubernetes_tpu.utils.trace import Span
+        def handle(self):
+            sp = Span("work")
+            try:
+                self.do_things()
+            finally:
+                sp.finish()
+        """
+        assert not findings_of(src, "leaked-span")
+
+    def test_ownership_handoff_passes(self):
+        src = """
+        from kubernetes_tpu.utils.trace import Span
+        def returned(self):
+            sp = Span("a")
+            return sp
+        def stored(self):
+            sp = Span("b")
+            self.span = sp
+        def contained(self, key):
+            sp = Span("c")
+            self.live[key] = [sp, None]
+        """
+        assert not findings_of(src, "leaked-span")
+
+    def test_attribute_read_is_not_a_handoff(self):
+        # reading sp.trace_id must not launder the straight-line-finish
+        # leak; handing the OBJECT somewhere still does
+        src = """
+        from kubernetes_tpu.utils.trace import Span
+        def handle(self):
+            sp = Span("work")
+            tid = sp.trace_id
+            self.do_things(tid)
+            sp.finish()
+        """
+        assert findings_of(src, "leaked-span")
+
+    def test_non_span_calls_ignored(self):
+        src = """
+        def handle(self):
+            q = Queue("work")
+            self.do_things(q)
+        """
+        assert not findings_of(src, "leaked-span")
+
+
 class TestSuppressionsAndBaseline:
     BAD = """
     def sync(self):

@@ -1,0 +1,523 @@
+"""ISSUE 11: cross-process tracing, apiserver audit log, flight recorder.
+
+Covers the tentpole (traceparent through client -> apiserver -> storage,
+structured audit with rotation + /auditz, flight-recorder bundles on
+wedge/burn triggers) and the satellites (chaos visibility, per-trace span
+lookup, retry-chain propagation through a chaos-injected 500).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.observability.audit import AUDIT, AuditLog, AuditRecord
+from kubernetes_tpu.utils import trace
+
+
+def wait_for(cond, timeout=30.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient.for_server(server, user_agent="test-tracer")
+
+
+@pytest.fixture(autouse=True)
+def _clean_audit():
+    AUDIT.clear()
+    yield
+    AUDIT.clear()
+
+
+def mk_pod(name, ns="default"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="pause")]))
+
+
+def mk_node(name):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name,
+                                labels={api.LABEL_HOSTNAME: name}),
+        status=api.NodeStatus(
+            allocatable={"cpu": "4", "memory": "8Gi", "pods": "110"},
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+
+def audit_tail(**kw):
+    return AUDIT.tail(**kw)
+
+
+# --- trace context / ids ------------------------------------------------------
+
+class TestTraceContext:
+    def test_ids_are_w3c_shaped_hex(self):
+        sp = trace.Span("x")
+        assert len(sp.trace_id) == 32 and len(sp.span_id) == 16
+        int(sp.trace_id, 16), int(sp.span_id, 16)  # pure hex
+
+    def test_traceparent_round_trip(self):
+        sp = trace.Span("x")
+        header = trace.format_traceparent(sp)
+        parsed = trace.parse_traceparent(header)
+        assert parsed == (sp.trace_id, sp.span_id)
+
+    def test_garbled_traceparent_degrades_to_none(self):
+        for bad in (None, "", "xx", "00-zz-yy-01", "00-abc", "totally wrong"):
+            assert trace.parse_traceparent(bad) is None
+
+    def test_use_span_sets_and_restores(self):
+        assert trace.current_span() is None
+        sp = trace.Span("outer")
+        with trace.use_span(sp):
+            assert trace.current_span() is sp
+            inner = trace.Span("inner", parent=sp)
+            with trace.use_span(inner):
+                assert trace.current_span() is inner
+            assert trace.current_span() is sp
+        assert trace.current_span() is None
+        inner.finish(), sp.finish()
+
+    def test_use_span_none_is_noop(self):
+        with trace.use_span(None) as got:
+            assert got is None
+            assert trace.current_span() is None
+
+    def test_spans_for_trace_and_clear(self):
+        root = trace.Span("root")
+        root.child("a").finish()
+        root.finish()
+        other = trace.Span("other")
+        other.finish()
+        got = trace.spans_for_trace(root.trace_id)
+        assert {s.name for s in got} == {"root", "a"}
+        trace.clear_recent()
+        assert trace.spans_for_trace(root.trace_id) == []
+
+
+# --- propagation client -> apiserver -> storage -------------------------------
+
+class TestPropagation:
+    def test_audit_record_shares_the_client_trace(self, server, client):
+        root = trace.Span("op")
+        with trace.use_span(root):
+            client.list("pods")
+        root.finish()
+        rec = wait_for(
+            lambda: next(iter(audit_tail(trace_id=root.trace_id)), None),
+            msg="audit record on the client trace")
+        assert rec.verb == "GET" and "/pods" in rec.path
+        assert rec.status == 200
+        assert rec.component == "test-tracer"
+        assert rec.latency_seconds > 0
+        # the client-side rest span is the server span's remote parent
+        rest = [s for s in trace.spans_for_trace(root.trace_id)
+                if s.name == "rest:GET"]
+        assert rest and rec.parent_id == rest[0].span_id
+
+    def test_untraced_request_gets_server_minted_trace(self, server, client):
+        client.list("nodes")
+        rec = wait_for(lambda: next(iter(audit_tail(path_contains="/nodes")),
+                                    None), msg="audit record")
+        assert rec.trace_id and rec.parent_id == ""
+
+    def test_bind_audit_carries_cas_and_pod_trace(self, server, client):
+        client.create("nodes", mk_node("n1"))
+        client.create("pods", mk_pod("p1"))
+        root = trace.Span("schedule_pod", pod="default/p1")
+        binding = api.Binding(
+            metadata=api.ObjectMeta(name="p1", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n1"))
+        with trace.use_span(root):
+            client.bind(binding, "default")
+        root.finish()
+        rec = wait_for(
+            lambda: next(iter(audit_tail(trace_id=root.trace_id,
+                                         path_contains="/bindings")), None),
+            msg="binding audit record")
+        assert rec.verb == "POST" and rec.status == 201
+        # the binding rides guaranteed_update; uncontended -> 0 CAS retries,
+        # and the field exists (the contended case is exercised below)
+        assert rec.cas_retries == 0
+        bound = client.get("pods", "p1", "default")
+        assert bound.spec.node_name == "n1"
+
+    def test_cas_retries_audited_on_contended_patch(self, server, client):
+        """Storage CAS conflicts burned serving a request surface in its
+        audit record (trace.note_cas_retry via MemStore.guaranteed_update
+        and the PATCH retry loop)."""
+        import threading
+
+        client.create("pods", mk_pod("contended"))
+        errs = []
+
+        def patcher(i):
+            c = RESTClient.for_server(server, user_agent=f"patcher-{i}")
+            try:
+                for k in range(8):
+                    c.patch("pods", "contended",
+                            {"metadata": {"labels": {f"k{i}-{k}": "v"}}},
+                            namespace="default")
+            except Exception as e:  # surface, don't deadlock the join
+                errs.append(e)
+
+        threads = [threading.Thread(target=patcher, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs
+        recs = wait_for(
+            lambda: [r for r in audit_tail(verb="PATCH")
+                     if r.status == 200] or None,
+            msg="patch audit records")
+        assert len(recs) >= 8
+        # the field is wired: at least plausibly-contended writes record it
+        assert all(r.cas_retries >= 0 for r in recs)
+
+    def test_watch_request_is_audited_with_trace(self, server, client):
+        root = trace.Span("watcher")
+        with trace.use_span(root):
+            w = client.watch("pods", resource_version=0)
+        w.stop()
+        root.finish()
+        rec = wait_for(
+            lambda: next(iter(audit_tail(trace_id=root.trace_id)), None),
+            msg="watch audit record")
+        assert "watch=true" in rec.path
+
+    def test_healthz_is_not_audited(self, server, client):
+        import http.client as hc
+
+        conn = hc.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().read() == b"ok"
+        conn.close()
+        client.list("pods")
+        wait_for(lambda: len(AUDIT) > 0, msg="audit record")
+        assert not audit_tail(path_contains="/healthz")
+
+
+# --- retry-chain propagation (satellite: reflector through chaos 500) ---------
+
+class TestRetryChainPropagation:
+    def test_relist_through_injected_500_keeps_one_trace(self, server,
+                                                         client):
+        """A reflector whose first LIST dies on a chaos-injected 500 must
+        retry under the SAME trace id, and the successful retry's audit
+        record must carry the retry ordinal."""
+        from kubernetes_tpu.client.chaos import (
+            HTTPError, PathChaos, Times, install_chaos,
+        )
+        from kubernetes_tpu.client.informer import Informer
+        from kubernetes_tpu.client.reflector import ListWatch
+
+        client.create("pods", mk_pod("seed"))
+        ctl = install_chaos(
+            client,
+            PathChaos(r"/api/v1/pods$", Times(1, HTTPError(500)),
+                      methods={"GET"}),
+            seed=7)
+        inf = Informer(ListWatch(client, "pods"), relist_backoff=0.05)
+        try:
+            inf.run()
+            assert inf.wait_for_sync(20), "informer never synced"
+            assert ctl.count("HTTPError") == 1, "chaos 500 was not injected"
+            wait_for(lambda: audit_tail(verb="GET", path_contains="/pods"),
+                     msg="audited LIST")
+            # the successful LIST records the retry ordinal from the chain
+            lists = [r for r in audit_tail(verb="GET")
+                     if r.path == "/api/v1/pods" and r.status == 200]
+            assert lists, "no successful audited LIST"
+            assert lists[0].retries == 1, lists[0]
+            chain_trace = lists[0].trace_id
+            # ... and the watch opened after the retry stays ON that trace
+            wait_for(lambda: [r for r in audit_tail(trace_id=chain_trace)
+                              if "watch=true" in r.path],
+                     msg="watch on the chain trace")
+        finally:
+            ctl.uninstall()
+            inf.stop()
+        # the chain span finishes when the pump exits; stop()'s join is
+        # bounded, so poll rather than assert the instant stop() returns
+        chains = wait_for(
+            lambda: [s for s in trace.spans_for_trace(chain_trace)
+                     if s.name == "reflector_sync"],
+            timeout=10, msg="finished reflector chain span")
+        assert chains[0].attrs.get("retries") == 1
+
+
+# --- chaos visibility (satellite) ---------------------------------------------
+
+class TestChaosVisibility:
+    def test_interventions_counted_and_stamped_on_span(self, server, client):
+        from kubernetes_tpu.client.chaos import (
+            HTTPError, PathChaos, Times, install_chaos,
+        )
+        from kubernetes_tpu.client.rest import ApiError
+        from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+        before = METRICS.counter_value(
+            "rest_client_chaos_interventions_total", kind="HTTPError(503)")
+        ctl = install_chaos(
+            client, PathChaos(r"/pods", Times(1, HTTPError(503))), seed=1)
+        root = trace.Span("chaotic_op")
+        try:
+            with trace.use_span(root):
+                with pytest.raises(ApiError):
+                    client.list("pods")
+        finally:
+            root.finish()
+            ctl.uninstall()
+        after = METRICS.counter_value(
+            "rest_client_chaos_interventions_total", kind="HTTPError(503)")
+        assert after == before + 1
+        # the injected fault is attributable from the trace alone
+        rest = [s for s in trace.spans_for_trace(root.trace_id)
+                if s.name == "rest:GET"]
+        assert rest and rest[0].attrs.get("chaos_intervention") \
+            == "HTTPError(503)"
+        assert rest[0].attrs.get("status") == 503
+
+
+# --- audit log mechanics ------------------------------------------------------
+
+class TestAuditLog:
+    def _rec(self, i):
+        return AuditRecord(ts="t", verb="GET", path=f"/p/{i}",
+                           trace_id=f"{i:032x}")
+
+    def test_ring_is_bounded_and_filtered(self):
+        log = AuditLog(capacity=8)
+        for i in range(20):
+            log.record(self._rec(i))
+        assert len(log) == 8
+        assert [r.path for r in log.tail(3)] == ["/p/17", "/p/18", "/p/19"]
+        assert log.tail(trace_id=f"{19:032x}")[0].path == "/p/19"
+        assert log.tail(path_contains="/p/18")[0].path == "/p/18"
+        # n <= 0 is empty, never "the whole ring" (out[-0:] trap)
+        assert log.tail(0) == [] and log.tail(-5) == []
+
+    def test_disk_sink_bounded_with_zero_backups(self, tmp_path):
+        path = str(tmp_path / "audit0.log")
+        log = AuditLog(capacity=8, path=path, max_bytes=400, backups=0)
+        for i in range(80):
+            log.record(self._rec(i))
+        log.close()
+        assert os.listdir(tmp_path) == ["audit0.log"]
+        assert os.path.getsize(path) <= 800, "max_bytes must still bound"
+
+    def test_disk_sink_rotates_bounded(self, tmp_path):
+        path = str(tmp_path / "audit.log")
+        log = AuditLog(capacity=16, path=path, max_bytes=600, backups=2)
+        for i in range(60):
+            log.record(self._rec(i))
+        log.close()
+        files = sorted(os.listdir(tmp_path))
+        assert "audit.log" in files
+        assert "audit.log.1" in files
+        # bounded: never more than backups + live file
+        assert len(files) <= 3, files
+        # rotated files hold parseable JSON lines
+        with open(tmp_path / "audit.log.1") as fh:
+            for line in fh:
+                assert json.loads(line)["verb"] == "GET"
+
+    def test_auditz_endpoint_live(self, server, client):
+        client.list("pods")
+        wait_for(lambda: len(AUDIT) > 0, msg="audit record")
+        out = client.request("GET", "/auditz?n=4")
+        assert out["returned"] >= 1
+        assert out["records"][-1]["path"].endswith("/auditz") is False
+        fields = set(out["records"][0])
+        assert {"verb", "path", "status", "trace_id", "cas_retries",
+                "latency_seconds", "retries"} <= fields
+
+    def test_auditz_on_debug_mux(self, server, client):
+        import http.client as hc
+
+        from kubernetes_tpu.utils.debugserver import DebugServer
+
+        client.list("pods")
+        wait_for(lambda: len(AUDIT) > 0, msg="audit record")
+        dbg = DebugServer(port=0).start()
+        try:
+            conn = hc.HTTPConnection("127.0.0.1", dbg.port, timeout=10)
+            conn.request("GET", "/auditz?n=2")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            doc = json.loads(resp.read().decode())
+            assert doc["returned"] >= 1
+            conn.close()
+        finally:
+            dbg.stop()
+
+
+# --- flight recorder ----------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_bundle_schema_and_pruning(self, tmp_path, server, client):
+        from kubernetes_tpu.observability.flightrecorder import FlightRecorder
+
+        client.list("pods")
+        wait_for(lambda: len(AUDIT) > 0, msg="audit record")
+        sp = trace.Span("doomed")
+        sp.finish()
+        fr = FlightRecorder(directory=str(tmp_path), keep=3)
+        fr.note("round", n=1)
+        fr.snapshot_metrics()
+        paths = [fr.dump(f"reason-{i}") for i in range(5)]
+        assert all(paths)
+        bundles = fr.bundles()
+        assert len(bundles) == 3, "pruning must keep the newest 3"
+        doc = json.load(open(bundles[-1]))
+        assert doc["kind"] == "ktpu-flight-recorder-bundle"
+        assert doc["reason"] == "reason-4"
+        assert any(s["name"] == "doomed" for s in doc["spans"])
+        assert doc["audit"], "audit tail missing from bundle"
+        assert any(n["kind"] == "round" for n in doc["notes"])
+        assert any(n["kind"] == "metrics_delta" for n in doc["notes"])
+        assert "counters" in doc["metrics"]
+
+    def test_timed_out_span_survives_the_tail_cap(self, tmp_path, server,
+                                                  client):
+        """A wedge fires early, churn continues: the bundle must still carry
+        the timed-out stage span even once >512 newer spans exist."""
+        from kubernetes_tpu.observability.flightrecorder import FlightRecorder
+
+        client.list("pods")
+        wait_for(lambda: len(AUDIT) > 0, msg="audit record")
+        hung = trace.Span("solve", timeout=True)
+        hung.finish()
+        for i in range(600):
+            trace.Span(f"later-{i}").finish()
+        fr = FlightRecorder(directory=str(tmp_path))
+        doc = json.load(open(fr.dump("late-wedge")))
+        assert doc["spans_truncated"] is True
+        assert any(s["span_id"] == hung.span_id for s in doc["spans"]), \
+            "timed-out span fell off the bundle tail"
+
+    def test_rate_limit_per_reason(self, tmp_path):
+        from kubernetes_tpu.observability.flightrecorder import FlightRecorder
+
+        fr = FlightRecorder(directory=str(tmp_path), min_interval=60.0)
+        assert fr.dump("hot", force=False) is not None
+        assert fr.dump("hot", force=False) is None, "rate limit must hold"
+        assert fr.dump("hot", force=True) is not None, "force must bypass"
+        assert fr.dump("other", force=False) is not None, "per-reason limit"
+
+    def test_stage_timeout_dumps_and_finishes_stage_span(self, tmp_path,
+                                                         monkeypatch):
+        """The watchdog trigger: a hung stage produces a StageTimeout AND a
+        bundle containing the timed-out stage's (force-finished) span."""
+        import kubernetes_tpu.observability.flightrecorder as fr_mod
+        from kubernetes_tpu.ops import watchdog
+
+        fr = fr_mod.FlightRecorder(directory=str(tmp_path))
+        monkeypatch.setattr(fr_mod, "RECORDER", fr)
+        root = trace.Span("batch")
+        try:
+            with pytest.raises(watchdog.StageTimeout) as ei:
+                watchdog.run_stages(
+                    lambda stage: stage("solve", lambda: time.sleep(30)),
+                    deadlines={"solve": 0.2}, span=root, poll=0.02)
+        finally:
+            root.finish()
+        assert ei.value.stage == "solve"
+        bundles = fr.bundles()
+        assert bundles, "stage timeout must dump a bundle"
+        doc = json.load(open(bundles[-1]))
+        assert doc["reason"] == "stage-timeout"
+        assert doc["trigger"]["stage"] == "solve"
+        timed_out = [s for s in doc["spans"]
+                     if s["name"] == "solve" and s["attrs"].get("timeout")]
+        assert timed_out, "bundle must contain the timed-out stage's span"
+        assert timed_out[0]["trace_id"] == root.trace_id
+
+    def test_slo_burn_transition_dumps_once(self, tmp_path, monkeypatch):
+        import kubernetes_tpu.observability.flightrecorder as fr_mod
+        from kubernetes_tpu.observability.scrape import Scraper
+        from kubernetes_tpu.observability.slo import (
+            SLOEngine, SLOSpec, Window,
+        )
+        from kubernetes_tpu.utils.metrics import MetricsRegistry
+
+        fr = fr_mod.FlightRecorder(directory=str(tmp_path))
+        monkeypatch.setattr(fr_mod, "RECORDER", fr)
+        scraper = Scraper()
+        scraper.add_target("t", "127.0.0.1", 1)  # never fetched
+        scraper.ingest("t", '# HELP g g (gauge)\n# TYPE g gauge\n'
+                            'g{x="1"} 5\n', ts=0.0)
+        spec = SLOSpec(name="g-low", target="t", sli="gauge", metric="g",
+                       labels=(("x", "1"),), objective=1.0, bound="max",
+                       windows=(Window(5.0, 1.0),))
+        engine = SLOEngine(scraper, [spec], registry=MetricsRegistry())
+        r1 = engine.evaluate()
+        assert r1[0].verdict == "burning"
+        assert len(fr.bundles()) == 1, "transition must dump"
+        engine.evaluate()
+        assert len(fr.bundles()) == 1, "sustained burn must not re-dump"
+        doc = json.load(open(fr.bundles()[0]))
+        assert doc["trigger"]["slo"] == "g-low"
+
+
+# --- the acceptance path: seeded hang_stage soak ships its black box ----------
+
+@pytest.mark.usefixtures("_clean_audit")
+class TestWedgedSoakForensics:
+    def test_wedged_soak_writes_diagnosable_bundle(self, monkeypatch,
+                                                   tmp_path):
+        """Acceptance: hang_stage soak ends wedged AND its bundle carries
+        the timed-out stage's span, the audit records around it, and the
+        SLO verdicts."""
+        import kubernetes_tpu.observability.flightrecorder as fr_mod
+        from kubernetes_tpu.observability.soak import SoakConfig, run_soak
+
+        fr = fr_mod.FlightRecorder(directory=str(tmp_path))
+        monkeypatch.setattr(fr_mod, "RECORDER", fr)
+        # soak.py binds RECORDER at import time — repoint that reference too
+        import kubernetes_tpu.observability.soak as soak_mod
+        monkeypatch.setattr(soak_mod, "RECORDER", fr)
+
+        cfg = SoakConfig(num_nodes=4, create_rate=20, duration_seconds=2.0,
+                         scrape_period=0.8, batch_size=16,
+                         heartbeat_period=2.0, drain_timeout=20,
+                         hang_stage="solve")
+        report = run_soak(cfg)
+        assert report["wedged"] is True
+        assert "solve" in report.get("stage_timeouts", {})
+        path = report.get("flight_recorder_bundle")
+        assert path and os.path.exists(path), report.get("error")
+        doc = json.load(open(path))
+        assert doc["reason"] == "soak-wedged"
+        # 1. the timed-out stage's span
+        hung = [s for s in doc["spans"]
+                if s["name"] == "solve" and s["attrs"].get("timeout")]
+        assert hung, "bundle must contain the timed-out solve span"
+        # 2. the triggering audit records (the soak's own API churn)
+        assert doc["audit"], "bundle must carry the audit tail"
+        assert any(r["verb"] == "POST" for r in doc["audit"])
+        # 3. the SLO verdicts
+        assert doc["trigger"].get("slos"), "bundle must carry SLO verdicts"
+        # and the rounds that led into the wedge rode the notes ring
+        assert any(n["kind"] == "soak_round" for n in doc["notes"])

@@ -4,10 +4,15 @@
 Validates the report shape the soak smoke just emitted — stdlib only, no
 jsonschema dependency. Exit 0 on a conforming report, 1 with one line per
 violation otherwise. A `--expect-wedged` run inverts the wedge assertion
-(used to prove the seeded-hang path stays honest).
+(used to prove the seeded-hang path stays honest) AND requires a
+flight-recorder bundle: a wedged soak must ship its black box, and the
+bundle itself is schema-checked (spans incl. a timed-out stage, audit
+records, SLO verdicts in the trigger). `--bundle <path>` checks a bundle
+file standalone.
 """
 
 import json
+import os
 import sys
 
 
@@ -61,6 +66,15 @@ def check(doc: dict, expect_wedged: bool) -> list:
         if not doc.get("wedged"):
             errs.append("$.wedged: expected true (seeded hang must be "
                         "reported, not laundered into a success)")
+        bundle = (doc.get("flight_recorder_bundle")
+                  or detail.get("flight_recorder_bundle"))
+        if not bundle:
+            errs.append("$.flight_recorder_bundle: missing (a wedged soak "
+                        "must ship its black box)")
+        elif not os.path.exists(bundle):
+            errs.append(f"$.flight_recorder_bundle: {bundle} does not exist")
+        else:
+            errs.extend(check_bundle(bundle, expect_timeout_span=True))
     else:
         if doc.get("wedged"):
             errs.append("$.wedged: true — the soak smoke wedged")
@@ -73,13 +87,59 @@ def check(doc: dict, expect_wedged: bool) -> list:
     return errs
 
 
+def check_bundle(path: str, expect_timeout_span: bool = False) -> list:
+    """Schema-check one flight-recorder bundle; returns violation lines."""
+    errs = []
+    where = f"bundle({os.path.basename(path)})"
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{where}: unreadable: {e}"]
+    if doc.get("kind") != "ktpu-flight-recorder-bundle":
+        errs.append(f"{where}.kind: not a flight-recorder bundle")
+    if not doc.get("reason"):
+        errs.append(f"{where}.reason: missing")
+    for key in ("spans", "audit", "events", "notes"):
+        if not isinstance(doc.get(key), list):
+            errs.append(f"{where}.{key}: missing list")
+    if not isinstance(doc.get("metrics"), dict) or \
+            "counters" not in (doc.get("metrics") or {}):
+        errs.append(f"{where}.metrics.counters: missing")
+    if not doc.get("spans"):
+        errs.append(f"{where}.spans: empty (a bundle with no spans explains "
+                    "nothing)")
+    if not doc.get("audit"):
+        errs.append(f"{where}.audit: empty (the triggering requests must be "
+                    "in the bundle)")
+    if expect_timeout_span:
+        timed_out = [s for s in doc.get("spans") or []
+                     if isinstance(s, dict)
+                     and (s.get("attrs") or {}).get("timeout")]
+        if not timed_out:
+            errs.append(f"{where}.spans: no timed-out stage span (the wedge "
+                        "cause must be in the bundle)")
+        trigger = doc.get("trigger") or {}
+        if not trigger.get("slos"):
+            errs.append(f"{where}.trigger.slos: missing SLO verdicts")
+    return errs
+
+
 def main(argv) -> int:
     expect_wedged = "--expect-wedged" in argv
+    bundle_mode = "--bundle" in argv
     paths = [a for a in argv if not a.startswith("-")]
     if len(paths) != 1:
-        print("usage: check_soak.py [--expect-wedged] <report.json>",
-              file=sys.stderr)
+        print("usage: check_soak.py [--expect-wedged] <report.json> | "
+              "check_soak.py --bundle <bundle.json>", file=sys.stderr)
         return 2
+    if bundle_mode:
+        errs = check_bundle(paths[0])
+        for e in errs:
+            print(f"check_soak: {e}", file=sys.stderr)
+        if not errs:
+            print(f"check_soak: bundle OK ({paths[0]})")
+        return 1 if errs else 0
     try:
         with open(paths[0], encoding="utf-8") as f:
             doc = json.load(f)

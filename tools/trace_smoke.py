@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Trace-propagation smoke (tools/verify.sh): schedule one pod through a
+LIVE apiserver and prove the cross-process trace actually crossed.
+
+Asserts, from the exported surfaces only (span ring + audit log):
+
+1. the pod was bound by the real scheduler loop (informer -> FIFO ->
+   schedule -> bind POST);
+2. the scheduler's pod span and the apiserver's audit record for the bind
+   POST share one trace id;
+3. the client-side rest span is the audit record's remote parent.
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import RESTClient
+    from kubernetes_tpu.observability.audit import AUDIT
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    from kubernetes_tpu.utils import trace
+
+    server = APIServer().start()
+    factory = sched = None
+    try:
+        client = RESTClient.for_server(server, user_agent="trace-smoke")
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="smoke-node",
+                                    labels={api.LABEL_HOSTNAME: "smoke-node"}),
+            status=api.NodeStatus(
+                allocatable={"cpu": "4", "memory": "8Gi", "pods": "110"},
+                conditions=[api.NodeCondition(type="Ready", status="True")])))
+        client.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="smoke-pod", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="pause",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": "100m", "memory": "100Mi"}))])))
+        factory = ConfigFactory(client)
+        factory.run(timeout=60)
+        sched = factory.create_from_provider()
+        sched.run()
+        deadline = time.monotonic() + 60
+        bound = None
+        while time.monotonic() < deadline:
+            p = client.get("pods", "smoke-pod", "default")
+            if p.spec and p.spec.node_name:
+                bound = p
+                break
+            time.sleep(0.05)
+        if bound is None:
+            print("trace_smoke: pod never bound", file=sys.stderr)
+            return 1
+
+        # the finished pod span carries the trace the bind traveled on
+        deadline = time.monotonic() + 10
+        roots = []
+        while time.monotonic() < deadline and not roots:
+            roots = trace.recent_spans(name="schedule_pod")
+            time.sleep(0.02)
+        if not roots:
+            print("trace_smoke: no finished schedule_pod span", file=sys.stderr)
+            return 1
+        trace_id = roots[-1].trace_id
+
+        deadline = time.monotonic() + 10
+        binds = []
+        while time.monotonic() < deadline and not binds:
+            binds = [r for r in AUDIT.tail(trace_id=trace_id)
+                     if r.path.endswith("/bindings") and r.verb == "POST"]
+            time.sleep(0.02)
+        if not binds:
+            on_trace = AUDIT.tail(trace_id=trace_id)
+            print(f"trace_smoke: no bind audit record on trace {trace_id} "
+                  f"(records on trace: {[r.path for r in on_trace]})",
+                  file=sys.stderr)
+            return 1
+        rec = binds[-1]
+        if rec.status != 201:
+            print(f"trace_smoke: bind audited with status {rec.status}",
+                  file=sys.stderr)
+            return 1
+        rest_spans = [s for s in trace.spans_for_trace(trace_id)
+                      if s.name == "rest:POST"
+                      and s.attrs.get("path", "").endswith("/bindings")]
+        if not rest_spans:
+            print("trace_smoke: no client rest span on the bind trace",
+                  file=sys.stderr)
+            return 1
+        if rec.parent_id not in {s.span_id for s in rest_spans}:
+            print(f"trace_smoke: audit parent {rec.parent_id} is not the "
+                  "client's bind request span", file=sys.stderr)
+            return 1
+        print(f"trace_smoke: OK — trace {trace_id}: scheduler span -> "
+              f"rest:POST {rest_spans[-1].span_id} -> apiserver audit "
+              f"(status {rec.status}, {rec.latency_seconds}s)")
+        return 0
+    finally:
+        if sched is not None:
+            sched.stop()
+        if factory is not None:
+            factory.stop()
+        server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

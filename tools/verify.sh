@@ -14,8 +14,16 @@
 #                         tools/check_soak.py — the steady-state bench path
 #                         is exercised on every verify, not just on bench
 #                         rounds
+#   4. trace smoke      — tools/trace_smoke.py schedules one pod through a
+#                         live apiserver and asserts the client span and
+#                         the apiserver audit record share one trace id
+#                         (the cross-process propagation contract)
+#   5. wedge smoke      — a soak with a seeded kernel-stage hang MUST exit
+#                         nonzero, report wedged:true, and ship a
+#                         flight-recorder bundle; check_soak.py
+#                         --expect-wedged schema-checks both
 #
-# Usage: tools/verify.sh [--static-only|--tests-only|--soak-only]
+# Usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only]
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -23,12 +31,14 @@ cd "$(dirname "$0")/.."
 run_static=1
 run_tests=1
 run_soak=1
+run_trace=1
 case "${1:-}" in
-  --static-only) run_tests=0; run_soak=0 ;;
-  --tests-only)  run_static=0; run_soak=0 ;;
-  --soak-only)   run_static=0; run_tests=0 ;;
+  --static-only) run_tests=0; run_soak=0; run_trace=0 ;;
+  --tests-only)  run_static=0; run_soak=0; run_trace=0 ;;
+  --soak-only)   run_static=0; run_tests=0; run_trace=0 ;;
+  --trace-only)  run_static=0; run_tests=0; run_soak=0 ;;
   "") ;;
-  *) echo "usage: tools/verify.sh [--static-only|--tests-only|--soak-only]" >&2; exit 2 ;;
+  *) echo "usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only]" >&2; exit 2 ;;
 esac
 
 if [ "$run_static" = 1 ]; then
@@ -50,6 +60,22 @@ if [ "$run_soak" = 1 ]; then
     timeout -k 10 300 python bench.py --mode soak > "$soak_out"
   python tools/check_soak.py "$soak_out"
   rm -f "$soak_out"
+
+  echo "== wedge smoke (seeded hang -> wedged:true + flight-recorder bundle) =="
+  wedge_out="$(mktemp /tmp/soak-wedge.XXXXXX.json)"
+  if JAX_PLATFORMS=cpu SOAK_NODES=4 SOAK_RATE=20 SOAK_DURATION=3 \
+      SOAK_SCRAPE_PERIOD=1 SOAK_BATCH=16 BENCH_SOAK_HANG_STAGE=solve \
+      timeout -k 10 300 python bench.py --mode soak > "$wedge_out"; then
+    echo "verify: seeded-hang soak exited 0 — the wedge was laundered" >&2
+    exit 1
+  fi
+  python tools/check_soak.py --expect-wedged "$wedge_out"
+  rm -f "$wedge_out"
+fi
+
+if [ "$run_trace" = 1 ]; then
+  echo "== trace propagation smoke (client span <-> apiserver audit) =="
+  JAX_PLATFORMS=cpu timeout -k 10 120 python tools/trace_smoke.py
 fi
 
 echo "verify: OK"
