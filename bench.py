@@ -551,6 +551,60 @@ def run_e2e(n_nodes: int, n_pods: int) -> dict:
         server.stop()
 
 
+def measure_explain_overhead(jax_mod) -> dict:
+    """Device-cost gate for the explain feature (ISSUE 12): at the smoke
+    shape (the full-carry-surface fixture batch), solve time with explain
+    on must stay within 2% of explain off. Medians over interleaved
+    perturbed dispatches; `exceeded` additionally requires a >5 ms absolute
+    delta so scheduler-noise on a ~ms solve can't fail a CI run. Also
+    asserts on/off assignments are identical (the bit-exact-neutral
+    contract, on real dispatch inputs)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops.fixtures import feature_batch
+    from kubernetes_tpu.ops.kernel import Weights, _schedule_jit, features_of
+
+    runs = max(3, int(os.environ.get("BENCH_EXPLAIN_RUNS", 15)))
+    ct = feature_batch(n_nodes=128, n_pods=64, with_existing=True)
+    arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
+    jax_mod.block_until_ready(arrays)
+    feats, w = features_of(ct), Weights()
+
+    def solve(a, explain):
+        out = _schedule_jit(a, ct.n_zones, w, feats, explain)
+        return jax_mod.tree_util.tree_map(np.asarray, out)
+
+    base_out = solve(arrays, False)     # warm both compiles
+    exp_out = solve(arrays, True)
+    if not np.array_equal(base_out[: ct.n_real_pods],
+                          exp_out[0][: ct.n_real_pods]):
+        return {"error": "explain=on changed assignments at the smoke shape",
+                "exceeded": True}
+
+    times = {False: [], True: []}
+    for k in range(1, runs + 1):
+        a = dict(arrays)
+        a["used0"] = arrays["used0"].at[0, 0].add(np.float32(k) * 1e-3)
+        jax_mod.block_until_ready(a["used0"])
+        for explain in (False, True):   # interleaved: shared thermal drift
+            t0 = time.perf_counter()
+            solve(a, explain)
+            times[explain].append(time.perf_counter() - t0)
+
+    import statistics
+    base_med = statistics.median(times[False])
+    exp_med = statistics.median(times[True])
+    rel = (exp_med / base_med - 1.0) if base_med > 0 else 0.0
+    return {
+        "runs": runs,
+        "base_seconds": round(base_med, 5),
+        "explain_seconds": round(exp_med, 5),
+        "relative": round(rel, 4),
+        "exceeded": bool(rel > 0.02 and (exp_med - base_med) > 0.005),
+    }
+
+
 def restart_probe() -> None:
     """Fresh-process cold start against the persistent compilation cache:
     module load -> backend -> tensorize -> upload -> (cached) compile ->
@@ -745,6 +799,16 @@ def main() -> int:
     if os.environ.get("BENCH_RESTART", "1") != "0":
         restart = run_restart_probe()
 
+    explain_overhead = None
+    if os.environ.get("BENCH_EXPLAIN", "1") != "0":
+        try:
+            explain_overhead = run_with_timeout(
+                lambda: measure_explain_overhead(jax), 600, "explain overhead")
+        except Exception as e:
+            # a gate that cannot measure must fail, not silently pass
+            # (the error key is checked alongside `exceeded` below)
+            explain_overhead = {"error": repr(e)}
+
     # correctness guard: no node overcommitted on cpu or pod slots
     # (existing bound pods count toward both caps — 100m each)
     assign = res[res >= 0]
@@ -785,6 +849,8 @@ def main() -> int:
         result["detail"]["e2e"] = e2e
     if restart is not None:
         result["detail"]["restart"] = restart
+    if explain_overhead is not None:
+        result["detail"]["explain_overhead"] = explain_overhead
     if suspect:
         result["detail"]["estimator_notes"] = suspect
     if backend_err is not None:
@@ -808,6 +874,9 @@ def main() -> int:
     print(json.dumps(result))
     if restart is not None and restart.get("error"):
         return 1  # a failed restart probe is not a clean measurement
+    if explain_overhead is not None and (explain_overhead.get("exceeded")
+                                         or explain_overhead.get("error")):
+        return 1  # explain must stay within 2% — and must be measurable
     return 1 if timeouts else 0
 
 

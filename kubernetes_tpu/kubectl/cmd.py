@@ -147,9 +147,9 @@ def _describe_lines(resource: str, obj) -> List[str]:
     return lines
 
 
-def _event_lines(client, resource: str, obj) -> List[str]:
-    """Events involving this object (reference describe.go: every describer
-    ends with the object's event stream)."""
+def _object_events(client, resource: str, obj) -> list:
+    """This object's Event stream (fetched ONCE per describe; both the
+    Scheduling and Events sections render from it)."""
     rd = RESOURCES.get(resource)
     kind = rd.kind if rd else resource
     m = obj.metadata or api.ObjectMeta()
@@ -162,6 +162,44 @@ def _event_lines(client, resource: str, obj) -> List[str]:
                            f"involvedObject.name={m.name}")
     except ApiError:
         return []
+    return evs
+
+
+def _scheduling_lines(resource: str, obj, events: list) -> List[str]:
+    """Scheduling section for pods (reference describe.go has no analogue —
+    this surfaces the decision ledger's provenance): the Unschedulable
+    breakdown from the PodScheduled condition, or — for placed pods — the
+    chosen node plus the score breakdown and runner-up the scheduler
+    stamped onto the Scheduled event."""
+    if resource != "pods":
+        return []
+    st = obj.status
+    cond = next((c for c in ((st.conditions or []) if st else [])
+                 if c.type == api.POD_SCHEDULED), None)
+    if cond is not None and cond.status == api.CONDITION_FALSE \
+            and (cond.message or ""):
+        return ["Scheduling:", f"  Unschedulable:\t{cond.message}"]
+    node = obj.spec.node_name if obj.spec else ""
+    if not node:
+        return []
+    for e in sorted(events, key=lambda e: e.last_timestamp or "",
+                    reverse=True):
+        msg = e.message or ""
+        if e.reason == "Scheduled" and " [score " in msg:
+            detail = msg.split(" [", 1)[1].rstrip("]")
+            lines = ["Scheduling:", f"  Node:\t{node}"]
+            for part in detail.split("; "):
+                if part.startswith("runner-up "):
+                    lines.append(f"  Runner-up:\t{part[len('runner-up '):]}")
+                else:
+                    lines.append(f"  Decision:\t{part}")
+            return lines
+    return []
+
+
+def _event_lines(evs: list) -> List[str]:
+    """Events involving this object (reference describe.go: every describer
+    ends with the object's event stream)."""
     if not evs:
         return []
     lines = ["Events:", "  LastSeen\tCount\tFrom\tType\tReason\tMessage"]
@@ -181,8 +219,10 @@ def cmd_describe(args) -> int:
     chunks = []
     for resource, objs in blocks:
         for o in objs:
+            evs = _object_events(client, resource, o)
             lines = _describe_lines(resource, o)
-            lines += _event_lines(client, resource, o)
+            lines += _scheduling_lines(resource, o, evs)
+            lines += _event_lines(evs)
             chunks.append("\n".join(lines))
     print("\n\n\n".join(chunks))
     return 0

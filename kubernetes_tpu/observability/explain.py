@@ -1,0 +1,478 @@
+"""Scheduler decision ledger: per-predicate why/why-not explainability.
+
+The reference scheduler's signature observability artifact is the per-pod
+failure breakdown ("0/5000 nodes are available: 3200 Insufficient cpu,
+1800 MatchNodeSelector.") — plugin/pkg/scheduler/generic_scheduler.go:40-67
+histograms each node's FIRST failing predicate.  The batched kernel
+(ops/kernel.py) collapses every predicate into one fused mask, so this
+module defines the shared taxonomy both sides speak:
+
+- ``PREDICATES`` is the canonical elimination order.  The kernel emits, per
+  pod, cumulative surviving-node counts after each row (static rows from
+  static_pass, dynamic rows from the scan step — reductions over the masks
+  the solve already computed).  ``oracle_breakdown`` replays the SAME rows
+  node-by-node through the Python predicates (scheduler/predicates.py), and
+  the oracle-equivalence test (tests/test_explain.py) pins them equal.
+- ``decode_batch`` turns the kernel's raw extras into ``DecisionRecord``s:
+  elimination histogram for unschedulable pods, winner + runner-up score
+  decompositions (scheduler/priorities.py component names) for placed ones.
+  Score components the kernel legitimately omits as argmax-neutral
+  constants (taint_toleration=10 when no PreferNoSchedule taint is traced,
+  equal) are reconstructed here so totals match the priorities.py replay
+  exactly.
+- ``DecisionLedger`` is the bounded ring behind ``/explainz`` on every
+  debug mux and the ``decisions`` block of flight-recorder bundles.
+- ``note_unschedulable`` feeds ``scheduler_unschedulable_reasons_total
+  {predicate}`` (incremented by eliminated-node count), for both kernel
+  decisions (exact, from the record) and sequential-oracle FitErrors
+  (parsed from the per-node failure map).
+
+Import-light on purpose: no jax at module import — kernel helpers are
+imported lazily inside the decode, so the debug mux can serve /explainz in
+processes that never touch a device.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.scheduler.generic import FitError
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+from kubernetes_tpu.utils.timeutil import now_iso as _now_iso
+
+# Canonical predicate rows, in elimination order: (key, reason text).  The
+# kernel's cumulative survivor chain and the Python replay both walk this
+# exact order, so "first failing predicate" attribution agrees bit-for-bit.
+# MatchNodeSelector covers nodeSelector AND volume-zone labels (the kernel
+# folds a bound PV's zone/region requirements into the selector columns —
+# ops/tensorize.py _fold_volume_zone).
+PREDICATES: Tuple[Tuple[str, str], ...] = (
+    ("MatchNodeSelector", "MatchNodeSelector"),
+    ("NodeAffinity", "MatchNodeAffinity"),
+    ("PodToleratesNodeTaints", "PodToleratesNodeTaints"),
+    ("CheckNodeMemoryPressure", "NodeUnderMemoryPressure"),
+    ("HostName", "HostName"),
+    ("MaxPods", "Too many pods"),
+    ("InsufficientCPU", "Insufficient cpu"),
+    ("InsufficientMemory", "Insufficient memory"),
+    ("InsufficientGPU", "Insufficient gpu"),
+    ("PodFitsHostPorts", "PodFitsHostPorts"),
+    ("NoDiskConflict", "NoDiskConflict"),
+    ("MaxVolumeCount", "MaxVolumeCount"),
+    ("MatchInterPodAffinity", "MatchInterPodAffinity"),
+)
+PREDICATE_KEYS = tuple(k for k, _ in PREDICATES)
+_REASON_TEXT = dict(PREDICATES)
+N_STATIC_ROWS = 5  # selector..host come from static_pass; the rest from scan
+
+# Canonical score component order (scheduler/priorities.py names); decode
+# and oracle both emit every component whose weight is nonzero.
+COMPONENTS: Tuple[str, ...] = (
+    "least_requested", "balanced", "spread", "node_affinity",
+    "taint_toleration", "interpod_affinity", "image_locality", "equal",
+)
+
+REASONS_COUNTER = "scheduler_unschedulable_reasons_total"
+
+
+@dataclass
+class DecisionRecord:
+    """One scheduling decision, fully explained."""
+
+    pod: str                           # ns/name
+    node: Optional[str]                # chosen node; None = unschedulable
+    nodes_total: int                   # schedulable-node universe size
+    survivors: Tuple[int, ...]         # cumulative, len == len(PREDICATES)
+    score: Optional[float] = None
+    components: Dict[str, float] = field(default_factory=dict)
+    runner_up: Optional[str] = None
+    runner_up_score: Optional[float] = None
+    runner_up_components: Dict[str, float] = field(default_factory=dict)
+    ts: str = ""
+
+    @property
+    def feasible(self) -> int:
+        return self.survivors[-1] if self.survivors else 0
+
+    def eliminations(self) -> "OrderedDict[str, int]":
+        """predicate key -> nodes it eliminated (first-failure attribution),
+        canonical order, zero rows omitted."""
+        out: "OrderedDict[str, int]" = OrderedDict()
+        prev = self.nodes_total
+        for key, surv in zip(PREDICATE_KEYS, self.survivors):
+            gone = prev - surv
+            if gone > 0:
+                out[key] = gone
+            prev = surv
+        return out
+
+    def to_dict(self) -> dict:
+        d = {
+            "pod": self.pod, "node": self.node,
+            "nodes_total": self.nodes_total,
+            "survivors": list(self.survivors),
+            "eliminations": dict(self.eliminations()),
+            "ts": self.ts,
+        }
+        if self.node is None:
+            d["reason"] = format_reason(self)
+        else:
+            d.update({
+                "score": self.score, "components": dict(self.components),
+                "runner_up": self.runner_up,
+                "runner_up_score": self.runner_up_score,
+                "runner_up_components": dict(self.runner_up_components),
+                "summary": format_assigned(self),
+            })
+        return d
+
+
+def format_reason(rec: DecisionRecord) -> str:
+    """The reference-style unschedulable breakdown: '0/N nodes are
+    available: <count> <reason>, ...' — counts descending, names as
+    tie-break, trailing period included (generic_scheduler.go:40-67
+    flavor)."""
+    elim = rec.eliminations()
+    if not elim:
+        return (f"0/{rec.nodes_total} nodes are available: "
+                f"no schedulable nodes.")
+    parts = ", ".join(
+        f"{n} {_REASON_TEXT[k]}"
+        for k, n in sorted(elim.items(), key=lambda kv: (-kv[1], kv[0])))
+    return f"0/{rec.nodes_total} nodes are available: {parts}."
+
+
+def format_assigned(rec: DecisionRecord) -> str:
+    """Compact winner summary carried on the Scheduled event (and parsed
+    back by kubectl describe's Scheduling section)."""
+    comps = " ".join(f"{k}={v:g}" for k, v in rec.components.items())
+    s = f"score {rec.score:g} ({comps})"
+    if rec.runner_up is not None:
+        s += f"; runner-up {rec.runner_up} score {rec.runner_up_score:g}"
+    return s
+
+
+def reason_signature(rec: DecisionRecord) -> Tuple[str, ...]:
+    """The elimination histogram's SHAPE (which predicates fired, not their
+    exact counts): the event-dedup identity, so retries whose counts drift
+    with cluster churn still collapse onto one FailedScheduling Event."""
+    return tuple(sorted(rec.eliminations().keys()))
+
+
+class KernelFitError(FitError):
+    """FitError whose message is the kernel's reference-style breakdown and
+    which carries the full DecisionRecord for metrics/event correlation."""
+
+    def __init__(self, pod, record: DecisionRecord):
+        self.explanation = record
+        self.signature = reason_signature(record)
+        FitError.__init__(self, pod, {})
+        self._message = format_reason(record)
+
+    def __str__(self) -> str:
+        return self._message
+
+
+# --- kernel output decode -----------------------------------------------------
+
+def decode_batch(ct, out, extras, weights, feats) -> List[DecisionRecord]:
+    """Host decode of the kernel's explain extras into DecisionRecords.
+
+    `out` is the [P] assignment vector, `extras` the dict _schedule_jit
+    returned (static_surv/surv/win_*/run_*), both already numpy.  Constants
+    the kernel omits as argmax-neutral are added back here so totals equal
+    the priorities.py replay: taint_toleration contributes a flat
+    10*weight when no PreferNoSchedule taint is traced, equal a flat
+    weight*1 (already inside the kernel total when its weight is nonzero)."""
+    from kubernetes_tpu.ops.kernel import explain_component_names
+
+    wd = dict(weights.__dict__)
+    emitted = explain_component_names(feats, weights)
+    ts = _now_iso()
+    NEG_HALF = -5e8  # anything below: the NEG sentinel, not a score
+
+    static_surv = extras["static_surv"]
+    dyn_surv = extras["surv"]
+    win_comp = extras["win_comp"]
+    win_total = extras["win_total"]
+    run_idx = extras["run_idx"]
+    run_total = extras["run_total"]
+    run_comp = extras["run_comp"]
+
+    # canonical component names match Weights fields 1:1
+    wmap = {name: wd[name] for name in COMPONENTS}
+    taint_const = (float(wmap["taint_toleration"]) * 10.0
+                   if "taint_toleration" not in emitted
+                   and wmap["taint_toleration"] else 0.0)
+
+    def _components(vec) -> Dict[str, float]:
+        comp = {name: float(v) for name, v in zip(emitted, vec)}
+        for name in COMPONENTS:
+            if name in comp or not wmap[name]:
+                continue
+            if name == "taint_toleration":
+                comp[name] = taint_const
+            elif name == "equal":
+                comp[name] = float(wmap["equal"])  # already in kernel total
+            else:
+                comp[name] = 0.0  # oracle value when the feature is absent
+        return {name: comp[name] for name in COMPONENTS if name in comp}
+
+    # the kernel's survivor chain starts from node_valid — in the
+    # incremental mirror n_real_nodes is the slot high-water mark and can
+    # exceed the live node count (holes), so count the valid mask itself
+    nodes_total = int(ct.node_valid.sum())
+    records: List[DecisionRecord] = []
+    for i in range(ct.n_real_pods):
+        surv = tuple(int(round(float(v))) for v in static_surv[i]) + \
+            tuple(int(round(float(v))) for v in dyn_surv[i])
+        n = int(out[i])
+        pod_key = ct.pod_keys[i]
+        if n < 0:
+            records.append(DecisionRecord(
+                pod=pod_key, node=None, nodes_total=nodes_total,
+                survivors=surv, ts=ts))
+            continue
+        rec = DecisionRecord(
+            pod=pod_key, node=ct.node_names[n], nodes_total=nodes_total,
+            survivors=surv, ts=ts,
+            score=float(win_total[i]) + taint_const,
+            components=_components(win_comp[i]))
+        if float(run_total[i]) > NEG_HALF:
+            ri = int(run_idx[i])
+            rec.runner_up = ct.node_names[ri]
+            rec.runner_up_score = float(run_total[i]) + taint_const
+            rec.runner_up_components = _components(run_comp[i])
+        records.append(rec)
+    return records
+
+
+# --- the ledger ---------------------------------------------------------------
+
+class DecisionLedger:
+    """Bounded ring of the newest decisions + latest-per-pod index, serving
+    /explainz and the flight recorder's `decisions` block."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: "deque[DecisionRecord]" = deque(maxlen=capacity)
+        self._by_pod: Dict[str, DecisionRecord] = {}
+
+    def add(self, rec: DecisionRecord) -> None:
+        with self._lock:
+            evicted = (self._ring[0]
+                       if len(self._ring) == self.capacity else None)
+            self._ring.append(rec)
+            if evicted is not None and self._by_pod.get(evicted.pod) is evicted:
+                del self._by_pod[evicted.pod]
+            self._by_pod[rec.pod] = rec
+
+    def get(self, pod: str) -> Optional[DecisionRecord]:
+        with self._lock:
+            return self._by_pod.get(pod)
+
+    def tail(self, n: int = 256) -> List[DecisionRecord]:
+        if n <= 0:
+            return []
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._by_pod.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+LEDGER = DecisionLedger()
+
+
+def render_explainz(ledger: DecisionLedger, pod: Optional[str] = None,
+                    n=None) -> dict:
+    """JSON-ready /explainz payload: the newest-last decision tail, or one
+    pod's latest decision (?pod=ns/name)."""
+    if pod:
+        rec = ledger.get(pod)
+        return {"pod": pod,
+                "decision": rec.to_dict() if rec is not None else None}
+    try:
+        count = int(n) if n else 64
+    except (TypeError, ValueError):
+        count = 64
+    return {"capacity": ledger.capacity, "size": len(ledger),
+            "decisions": [r.to_dict() for r in ledger.tail(count)]}
+
+
+# --- metrics ------------------------------------------------------------------
+
+def note_unschedulable(err: Exception) -> None:
+    """Feed scheduler_unschedulable_reasons_total{predicate} from a failed
+    decision: exact per-predicate eliminated-node counts when the error
+    carries a DecisionRecord (kernel path), a parsed per-node failure
+    histogram for plain FitErrors (sequential-oracle path)."""
+    rec = getattr(err, "explanation", None)
+    if rec is not None:
+        for pred, count in rec.eliminations().items():
+            METRICS.inc(REASONS_COUNTER, float(count), predicate=pred)
+        return
+    failed = getattr(err, "failed_predicates", None)
+    if not failed:
+        return
+    hist: Dict[str, int] = {}
+    for reason in failed.values():
+        # generic.find_nodes_that_fit formats values as "<PredicateKey>:
+        # <reason>" — take the key. Anything that isn't an identifier-shaped
+        # key (manual FitErrors, free text) buckets into "Other": a metric
+        # label must never grow one series per node/volume name.
+        name = str(reason).split(":", 1)[0].strip()
+        if not name.replace("_", "").isalnum():
+            name = "Other"
+        hist[name] = hist.get(name, 0) + 1
+    for name, count in hist.items():
+        METRICS.inc(REASONS_COUNTER, float(count), predicate=name)
+
+
+# --- the Python replay (the oracle-equivalence anchor) ------------------------
+
+def oracle_breakdown(nodes, existing, pending, args, assignments,
+                     weights=None) -> List[DecisionRecord]:
+    """Node-by-node replay of scheduler/predicates.py + priorities.py over
+    the canonical rows, with the kernel's sequential-commit semantics (each
+    pod's decision sees every prior in-batch commit from `assignments`).
+
+    This is the ground truth the kernel's explain output must match exactly
+    (the ISSUE-12 acceptance anchor): cumulative survivor counts per
+    predicate row, and — for placed pods — the winner/runner-up weighted
+    score decomposition."""
+    from kubernetes_tpu.api.serialization import deep_copy
+    from kubernetes_tpu.ops.kernel import Weights
+    from kubernetes_tpu.scheduler import predicates as preds
+    from kubernetes_tpu.scheduler import priorities as prios
+    from kubernetes_tpu.scheduler.cache import NodeInfo
+
+    w = weights or Weights()
+    wd = dict(w.__dict__)
+
+    info = {n.metadata.name: NodeInfo(n) for n in nodes}
+    for ep in existing:
+        name = ep.spec.node_name if ep.spec else ""
+        if name in info:
+            info[name].add_pod(ep)
+
+    pvc, pv = getattr(args, "pvc_lookup", None), getattr(args, "pv_lookup", None)
+    vz = preds.VolumeZoneChecker(pvc, pv) if pvc and pv else None
+    vol_ebs = preds.MaxPDVolumeCountChecker(
+        "ebs", preds.DEFAULT_MAX_EBS_VOLUMES, pvc, pv)
+    vol_gce = preds.MaxPDVolumeCountChecker(
+        "gce-pd", preds.DEFAULT_MAX_GCE_PD_VOLUMES, pvc, pv)
+    interpod = preds.InterPodAffinity(args.pod_lister, args.node_lookup)
+    interpod_prio = prios.InterPodAffinityPriority(
+        args.pod_lister, args.node_lookup,
+        getattr(args, "hard_pod_affinity_weight", 1))
+    spread = prios.SelectorSpread(args.service_lister, args.controller_lister,
+                                  args.replicaset_lister)
+    prio_fns = {
+        "least_requested": prios.least_requested,
+        "balanced": prios.balanced_resource_allocation,
+        "spread": spread,
+        "node_affinity": prios.node_affinity_priority,
+        "taint_toleration": prios.taint_toleration_priority,
+        "interpod_affinity": interpod_prio,
+        "image_locality": prios.image_locality_priority,
+        "equal": prios.equal_priority,
+    }
+
+    def _res_row(resource):
+        def chk(pod, ni):
+            try:
+                preds.pod_fits_resources(pod, ni)
+            except preds.InsufficientResource as e:
+                if e.resource == resource:
+                    raise
+        return chk
+
+    records: List[DecisionRecord] = []
+    for i, pod in enumerate(pending):
+        sel_pod = deep_copy(pod)
+        if sel_pod.spec:
+            sel_pod.spec.affinity = None
+        aff_pod = deep_copy(pod)
+        if aff_pod.spec:
+            aff_pod.spec.node_selector = None
+
+        def _sel(p, ni):
+            preds.pod_matches_node_selector(sel_pod, ni)
+            if vz is not None:
+                vz(p, ni)
+
+        def _volcap(p, ni):
+            vol_ebs(p, ni)
+            vol_gce(p, ni)
+
+        checks = [
+            _sel,
+            lambda p, ni: preds.pod_matches_node_selector(aff_pod, ni),
+            preds.pod_tolerates_node_taints,
+            preds.check_node_memory_pressure,
+            preds.pod_fits_host,
+            _res_row("pods"), _res_row("cpu"),
+            _res_row("memory"), _res_row("gpu"),
+            preds.pod_fits_host_ports,
+            preds.no_disk_conflict,
+            _volcap,
+            interpod,
+        ]
+        assert len(checks) == len(PREDICATES)
+        interpod.begin_pod(pod)
+        cand = list(nodes)
+        surv = []
+        for chk in checks:
+            kept = []
+            for nd in cand:
+                try:
+                    chk(pod, info[nd.metadata.name])
+                    kept.append(nd)
+                except preds.PredicateFailure:
+                    pass
+            cand = kept
+            surv.append(len(cand))
+
+        host = assignments[i]
+        rec = DecisionRecord(pod=f"{pod.metadata.namespace}/{pod.metadata.name}",
+                             node=host, nodes_total=len(nodes),
+                             survivors=tuple(surv))
+        if host is not None:
+            names = [name for name in COMPONENTS if wd[name]]
+            raw = {name: prio_fns[name](pod, info, cand) for name in names}
+            totals = {nd.metadata.name: float(sum(
+                wd[name] * raw[name][nd.metadata.name] for name in names))
+                for nd in cand}
+            rec.components = {name: float(wd[name] * raw[name][host])
+                              for name in names}
+            rec.score = totals[host]
+            best, best_s = None, None
+            for nd in cand:
+                nm = nd.metadata.name
+                if nm == host:
+                    continue
+                if best_s is None or totals[nm] > best_s:
+                    best, best_s = nm, totals[nm]
+            rec.runner_up, rec.runner_up_score = best, best_s
+            if best is not None:
+                rec.runner_up_components = {
+                    name: float(wd[name] * raw[name][best]) for name in names}
+            # commit (the replay's AssumePod)
+            committed = deep_copy(pod)
+            committed.spec.node_name = host
+            info[host].add_pod(committed)
+            if hasattr(args.pod_lister, "pods"):
+                args.pod_lister.pods.append(committed)
+        records.append(rec)
+    return records

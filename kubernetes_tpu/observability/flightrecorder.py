@@ -45,6 +45,8 @@ BUNDLE_VERSION = 1
 # family totals) — the ones a wedge postmortem reads first
 _FOCUS_COUNTERS = (
     "scheduler_stage_timeout_total",
+    "scheduler_unschedulable_reasons_total",
+    "scheduler_status_write_errors_total",
     "soak_phase_timeout_total",
     "slo_violations_total",
     "rest_client_chaos_interventions_total",
@@ -123,6 +125,7 @@ class FlightRecorder:
             self._seq += 1
             seq = self._seq
             notes = list(self._notes)
+        from kubernetes_tpu.observability.explain import LEDGER
         from kubernetes_tpu.utils.events import recent_events
         counters = METRICS.counter_totals()
         # span selection: the newest 512, PLUS every timed-out stage span
@@ -147,6 +150,10 @@ class FlightRecorder:
             "spans": [_span_dict(s) for s in timed_out + tail],
             "events": recent_events(256),
             "audit": [r.to_dict() for r in AUDIT.tail(512)],
+            # the decision-ledger tail: what the solve was DECIDING going
+            # into the wedge, per-predicate — "which stage hung" plus "what
+            # it was doing" in one artifact
+            "decisions": [r.to_dict() for r in LEDGER.tail(128)],
             "notes": notes,
             "metrics": {
                 "counters": counters,

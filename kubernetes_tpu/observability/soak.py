@@ -38,6 +38,7 @@ log = logging.getLogger("soak")
 E2E_HIST = "scheduler_e2e_scheduling_latency_seconds"
 QUEUE_HIST = "scheduler_pod_queue_wait_seconds"
 TIMEOUT_COUNTER = "scheduler_stage_timeout_total"
+REASONS_COUNTER = "scheduler_unschedulable_reasons_total"
 
 SOAK_PHASES = ("boot", "churn", "drain", "report")
 
@@ -105,6 +106,25 @@ def _e2e_count(rnd) -> float:
     fam = rnd.families.get(E2E_HIST) if rnd is not None else None
     h = fam.histogram() if fam is not None else None
     return h.count if h is not None else 0.0
+
+
+def _reasons_of(rnd) -> Dict[str, float]:
+    """Absolute scheduler_unschedulable_reasons_total values by predicate
+    in a scraped round."""
+    fam = rnd.families.get(REASONS_COUNTER) if rnd is not None else None
+    return ({dict(lk).get("predicate", "?"): v
+             for lk, v in fam.samples.items()} if fam else {})
+
+
+def _reasons_delta(rnd, base: Dict[str, float]) -> Dict[str, float]:
+    """Per-predicate unschedulable-reason movement vs the boot baseline —
+    reasons from before this soak are not this soak's reasons."""
+    out = {}
+    for pred, v in _reasons_of(rnd).items():
+        delta = v - base.get(pred, 0.0)
+        if delta > 0:
+            out[pred] = delta
+    return out
 
 
 def _mk_pod(i: int):
@@ -288,6 +308,7 @@ def _boot(cfg: SoakConfig, state: dict, scraper: Optional[Scraper]) -> None:
     state["timeout_base_by_stage"] = (
         {dict(lk).get("stage", "?"): v for lk, v in fam.samples.items()}
         if fam else {})
+    state["reasons_base"] = _reasons_of(base)
     state["e2e_base"] = _e2e_count(base)
     state["steady_base_count"] = state["e2e_base"]
     state["engine"] = SLOEngine(
@@ -303,7 +324,7 @@ def _seed_hang(sched, stage_name: str) -> None:
     finish wedged via the fallback path, never hang."""
     sched.stage_deadlines[stage_name] = 0.2
 
-    def hanging(pending, weights=None, device=None, stage=None):
+    def hanging(pending, weights=None, device=None, stage=None, **kw):
         run = stage or (lambda _n, fn: fn())
         return run(stage_name, lambda: time.sleep(3600))
 
@@ -348,6 +369,8 @@ def _record_round(cfg: SoakConfig, state: dict, report: dict,
             "scheduler", QUEUE_HIST, 0.99)),
         "watch_lag_seconds": num(scr.gauge_value(
             "scheduler", "informer_watch_lag_seconds", resource="pods")),
+        "unschedulable_reasons": _reasons_delta(
+            scr.last_good("scheduler"), state.get("reasons_base", {})),
         "slos": {r.name: r.verdict for r in engine.evaluate()},
     })
     rnd = report["rounds"][-1]
@@ -422,6 +445,11 @@ def _finalize(cfg: SoakConfig, state: dict, report: dict) -> None:
             "scheduler", QUEUE_HIST, 0.99, steady_window)),
     }
     out["slos"] = [r.as_dict() for r in engine.evaluate()]
+    # the scraped per-predicate unschedulable breakdown for the whole soak
+    # (ISSUE 12): {} on a clean run — present either way so consumers can
+    # rely on the key
+    out["unschedulable_reasons"] = _reasons_delta(
+        last, state.get("reasons_base", {}))
     out["kernel"] = {
         "batches": sched.kernel_batches, "pods": sched.kernel_pods,
         "failures": sched.kernel_failures, "health": sched.health,

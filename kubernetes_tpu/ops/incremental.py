@@ -1478,9 +1478,11 @@ class IncrementalTensorizer:
     # --- the full incremental decision path -----------------------------------
 
     def schedule(self, pending: List[api.Pod], weights=None,
-                 device=None, stage=None) -> List[Optional[str]]:
+                 device=None, stage=None, explain: bool = False):
         """build + device sync + kernel; returns node name (or None) per
         pending pod, FIFO order — drop-in for scheduler.batch.tpu_batch.
+        With explain, returns (names, DecisionRecords) decoded from the
+        kernel's per-predicate provenance (observability/explain.py).
 
         `stage(name, fn)` (ops/watchdog.run_stages hook) observes the
         pipeline as named stages: tensorize -> upload -> compile|solve.
@@ -1509,5 +1511,11 @@ class IncrementalTensorizer:
         n_zones = ct.n_zones
         arrays = run("upload", lambda: self._upload_staged(plan,
                                                            device=device))
-        out = dispatch(arrays, n_zones, weights, feats, stage=stage)
-        return assignments_to_names(out, ct)
+        out = dispatch(arrays, n_zones, weights, feats, stage=stage,
+                       explain=explain)
+        if not explain:
+            return assignments_to_names(out, ct)
+        out, extras = out
+        names = assignments_to_names(out, ct)
+        from kubernetes_tpu.observability.explain import decode_batch
+        return names, decode_batch(ct, out, extras, weights, feats)

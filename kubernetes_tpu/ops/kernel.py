@@ -144,6 +144,25 @@ class Features(NamedTuple):
         return self.ports or self.disk or self.ebs or self.gce
 
 
+def explain_component_names(feats: Features, w: Weights) -> List[str]:
+    """Score components the kernel emits on-device when `explain` is on, in
+    stack order. Must mirror the rows greedy_commit actually stacks: the
+    host decode (observability/explain.py) zips this list against the
+    emitted [P, C] component matrix. Components the batch can't exercise
+    are absent here and reconstructed host-side as their constant oracle
+    value (taint_toleration=10 when untraced, 0 otherwise)."""
+    names = ["least_requested", "balanced", "spread"]
+    if feats.node_pref:
+        names.append("node_affinity")
+    if feats.taint_pref:
+        names.append("taint_toleration")
+    if feats.interpod or feats.static_terms:
+        names.append("interpod_affinity")
+    if feats.image and w.image_locality != 0:
+        names.append("image_locality")
+    return names
+
+
 def features_of(ct: ClusterTensors) -> Features:
     """Host-side batch inspection -> static trace flags."""
     has_req = bool(ct.req_own.any())
@@ -180,11 +199,18 @@ def features_of(ct: ClusterTensors) -> Features:
 # --- stage A -----------------------------------------------------------------
 
 def static_pass(t: dict, feats: Optional[Features] = None,
-                weights: Optional[Weights] = None) -> dict:
+                weights: Optional[Weights] = None,
+                explain: bool = False) -> dict:
     """All [P, N] mask/score ingredients that don't depend on assignment.
 
     With feats/weights given, score rows the batch can't exercise are left
-    out entirely (no [P, N] materialization, no per-step stream)."""
+    out entirely (no [P, N] materialization, no per-step stream).
+
+    With explain, also emits `static_surv` [P, 5]: cumulative surviving-node
+    counts after each static predicate in the canonical order (selector,
+    node-affinity, taints, memory-pressure, host) — reductions over the
+    ingredient masks already in registers, never a [P, N, K] tensor. The
+    masks themselves (and therefore the assignments) are untouched."""
     node_labels = t["node_labels"]          # [N, L]
     N = t["alloc"].shape[0]
 
@@ -207,6 +233,16 @@ def static_pass(t: dict, feats: Optional[Features] = None,
         t["node_valid"][None, :] & sel_ok & aff_ok & taint_ok & mem_ok & host_ok)
 
     out = {"mask": static_mask}
+    if explain:
+        # cumulative survivor counts, canonical static order (the chain's
+        # last element equals static_mask, so the counts are exactly the
+        # masks the solve uses)
+        cum = jnp.broadcast_to(t["node_valid"][None, :], sel_ok.shape)
+        counts = []
+        for m in (sel_ok, aff_ok, taint_ok, mem_ok, host_ok):
+            cum = cum & m
+            counts.append(jnp.sum(cum.astype(jnp.float32), axis=1))
+        out["static_surv"] = jnp.stack(counts, axis=1)  # [P, 5]
     if feats is None or feats.node_pref:
         out["pref_count"] = (
             (t["pod_pref_term"] * t["pref_weight"][None, :]) @ t["pref_term_node"])
@@ -327,12 +363,33 @@ class _Layout:
         return row[self.spans[name]]
 
 
-def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
+def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
+                  explain: bool = False):
     """lax.scan over pods; returns assignments [P] i32 (-1 = unschedulable).
 
     Exactly the reference's sequential semantics (scheduler.go:93-155 one
     pod at a time over generic_scheduler.go:70-133), with the per-step work
-    packed into ~25 fused ops (see module docstring)."""
+    packed into ~25 fused ops (see module docstring).
+
+    With explain, additionally returns a dict of per-pod provenance emitted
+    straight from the scan — (assignments, extras) instead of assignments:
+
+    - ``surv`` [P, 8]: cumulative surviving-node counts after each dynamic
+      predicate (pod-count, cpu, mem, gpu, ports, disk, volume-caps,
+      inter-pod), continuing the static chain from static_pass — ONE
+      stacked masked reduction over the mask ingredients the step already
+      computed, never a [P, N, K] tensor. Rows for untraced features repeat
+      the previous count (zero eliminations), keeping the axis static.
+    - ``win_comp`` [P, C] / ``win_total`` [P]: the weighted score
+      decomposition at the chosen node (component order:
+      explain_component_names) and its total.
+    - ``run_idx`` / ``run_total`` / ``run_comp``: the runner-up node (max
+      score excluding the winner; NEG total = no second feasible node).
+
+    When explain is off this function traces the exact program it always
+    has — the flag is a static jit key, so `off` is bit-identical to
+    today's assignments, and `on` only ADDS reductions (the mask and score
+    math feeding the argmax is shared, also bit-identical)."""
     assert not feats.hw or feats.req, "hw carry requires the req term table"
     alloc = t["alloc"]                      # [N, 4]
     N = alloc.shape[0]
@@ -481,8 +538,27 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
         used = nstate[:4]                   # [4, N]
         used_nz = nstate[4:6]
         pod_count_ok = used[3] + 1.0 <= allocT[3]
-        res_fit = jnp.all(used[:3] + req_v[:3, None] <= allocT[:3], axis=0)
-        mask = x["mask"] & pod_count_ok & ((zero_req_f > 0) | res_fit)
+        if explain:
+            # per-resource rows: pc & (z|c) & (z|m) & (z|g) distributes to
+            # pc & (z | (c&m&g)) for booleans, so the final mask is
+            # bit-identical to the fused form below — each row is one
+            # elimination bucket (Too many pods / Insufficient cpu/mem/gpu)
+            surv_rows = []
+            mask = x["mask"]
+
+            def narrow(m):
+                nonlocal mask
+                if m is not None:
+                    mask = mask & m
+                surv_rows.append(mask)
+
+            narrow(pod_count_ok)
+            for r in range(3):
+                narrow((zero_req_f > 0)
+                       | (used[r] + req_v[r] <= allocT[r]))
+        else:
+            res_fit = jnp.all(used[:3] + req_v[:3, None] <= allocT[:3], axis=0)
+            mask = x["mask"] & pod_count_ok & ((zero_req_f > 0) | res_fit)
 
         # --- vocab features: ports + volumes (predicates.go:64-269,687) ------
         if use_vocab:
@@ -510,8 +586,12 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
                     gce_hit = gce_hit + cols[si]
             if feats.ports:
                 mask = mask & (port_clash == 0.0)
+            if explain:
+                surv_rows.append(mask)          # row: host ports
             if feats.disk:
                 mask = mask & (disk_clash == 0.0)
+            if explain:
+                surv_rows.append(mask)          # row: disk conflict
             if feats.ebs:
                 cnt_e = lay.of(row, "vol_cnt")[0]
                 union = nstate[_R_EBS] + cnt_e - ebs_hit
@@ -520,6 +600,11 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
                 cnt_g = lay.of(row, "vol_cnt")[1]
                 union = nstate[_R_GCE] + cnt_g - gce_hit
                 mask = mask & ((cnt_g == 0.0) | (union <= t["max_gce"]))
+            if explain:
+                surv_rows.append(mask)          # row: attach-count caps
+        elif explain:
+            # no vocab carries traced: zero eliminations on these rows
+            surv_rows.extend([mask, mask, mask])
 
         # --- inter-pod affinity: mask + score in two contractions ------------
         # (predicates.go:769-921, interpod_affinity.go:86-216)
@@ -559,6 +644,12 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
             c = ip2[1] if c is None else c + ip2[1]
         if viol is not None:
             mask = mask & (viol == 0.0)
+        if explain:
+            surv_rows.append(mask)              # row: inter-pod affinity
+            # the ONE stacked masked reduction: 8 cumulative masks -> counts
+            dyn_surv = jnp.sum(
+                jnp.stack([r.astype(jnp.float32) for r in surv_rows]),
+                axis=1)                                        # [8]
 
         # --- dynamic scores --------------------------------------------------
         tot_c = used_nz[0] + nz_v[0]
@@ -614,29 +705,49 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
                           fscore * (1.0 / 3.0) + (2.0 / 3.0) * zscore, fscore)
         spread = jnp.floor(jnp.where(has_group, blend, 10.0))
 
-        score = (wf["least_requested"] * least + wf["balanced"] * balanced
-                 + wf["spread"] * spread + wf["equal"] * 1.0)
+        # weighted per-component contributions; `comps` (explain only)
+        # mirrors explain_component_names order for the host decode
+        comps = []
+        c_lr = wf["least_requested"] * least
+        c_ba = wf["balanced"] * balanced
+        c_sp = wf["spread"] * spread
+        if explain:
+            comps += [c_lr, c_ba, c_sp]
+        score = c_lr + c_ba + c_sp + wf["equal"] * 1.0
         if feats.node_pref:
             max_pref = mx[ri["pref"]]
-            score = score + wf["node_affinity"] * jnp.where(
+            c_na = wf["node_affinity"] * jnp.where(
                 max_pref > 0.0, jnp.floor(10.0 * x["pref"] / max_pref), 0.0)
+            score = score + c_na
+            if explain:
+                comps.append(c_na)
         if feats.taint_pref:
             max_tp = mx[ri["tp"]]
-            score = score + wf["taint_toleration"] * jnp.where(
+            c_tt = wf["taint_toleration"] * jnp.where(
                 max_tp > 0.0,
                 jnp.floor((1.0 - x["taint_pref"] / max_tp) * 10.0), 10.0)
+            score = score + c_tt
+            if explain:
+                comps.append(c_tt)
         else:
             # constant 10 for every feasible node — shifts all candidates
-            # equally, so the argmax/tie set is unchanged; omitted
+            # equally, so the argmax/tie set is unchanged; omitted (the
+            # explain decode reconstructs the constant host-side)
             pass
         if c is not None:
             ip_max = jnp.maximum(mx[ri["ipmax"]], 0.0)
             ip_min = jnp.minimum(-mx[ri["ipmin"]], 0.0)
             ip_rng = ip_max - ip_min
-            score = score + wf["interpod_affinity"] * jnp.where(
+            c_ip = wf["interpod_affinity"] * jnp.where(
                 ip_rng > 0.0, jnp.floor(10.0 * (c - ip_min) / ip_rng), 0.0)
+            score = score + c_ip
+            if explain:
+                comps.append(c_ip)
         if use_image:
-            score = score + wf["image_locality"] * x["image"]
+            c_im = wf["image_locality"] * x["image"]
+            score = score + c_im
+            if explain:
+                comps.append(c_im)
 
         # --- selectHost: max + round-robin tie-break -------------------------
         masked_score = jnp.where(mask, score, NEG)
@@ -702,12 +813,33 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
             out["req_nomatch"] = carry["req_nomatch"] & ~(
                 (req_match_v > 0) & commit)
 
-        return out, chosen
+        if not explain:
+            return out, chosen
+
+        # --- explain extras: winner/runner-up score decomposition ------------
+        comp_stack = jnp.stack(comps)                          # [C, N]
+        Cn = comp_stack.shape[0]
+        win_comp = jax.lax.dynamic_slice(
+            comp_stack, (0, safe), (Cn, 1))[:, 0]              # [C]
+        # runner-up: best masked score excluding the winner (NEG when the
+        # feasible set has no second node — decoded to "no runner-up")
+        run_masked = jnp.where(idx_n == safe, NEG, masked_score)
+        run_total = jnp.max(run_masked)
+        run_idx = jnp.argmax(run_masked).astype(jnp.int32)
+        run_comp = jax.lax.dynamic_slice(
+            comp_stack, (0, run_idx), (Cn, 1))[:, 0]
+        return out, (chosen, {
+            "surv": dyn_surv, "win_comp": win_comp, "win_total": max_score,
+            "run_idx": run_idx, "run_total": run_total, "run_comp": run_comp,
+        })
 
     # unroll amortizes per-iteration loop overhead; the body is tiny
     # (elementwise over N + a few [T, N] contractions) so overhead dominates
-    _, assignments = jax.lax.scan(step, init, xs, unroll=8)
-    return assignments
+    if not explain:
+        _, assignments = jax.lax.scan(step, init, xs, unroll=8)
+        return assignments
+    _, (assignments, extras) = jax.lax.scan(step, init, xs, unroll=8)
+    return assignments, extras
 
 
 # --- public API ---------------------------------------------------------------
@@ -716,9 +848,10 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features):
 _INT_FIELDS = frozenset(("zone_id", "host_req", "node_dom", "pod_group"))
 
 
-@functools.partial(jax.jit, static_argnames=("n_zones", "weights", "feats"))
+@functools.partial(jax.jit,
+                   static_argnames=("n_zones", "weights", "feats", "explain"))
 def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
-                  feats: Features):
+                  feats: Features, explain: bool = False):
     # indicator/count matrices may arrive packed (int8/int16/int32 — 4x less
     # upload traffic than f32, ops/incremental.py); widen on-device where
     # the MXU wants floats. XLA fuses the casts into the consumers.
@@ -730,8 +863,12 @@ def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
         else:
             t[k] = v.astype(jnp.float32)
     t["n_zones"] = n_zones
-    s = static_pass(t, feats, weights)
-    return greedy_commit(t, s, weights, feats)
+    s = static_pass(t, feats, weights, explain=explain)
+    if not explain:
+        return greedy_commit(t, s, weights, feats)
+    assignments, extras = greedy_commit(t, s, weights, feats, explain=True)
+    extras["static_surv"] = s["static_surv"]
+    return assignments, extras
 
 
 def assignments_to_names(out: np.ndarray,
@@ -756,14 +893,14 @@ _DISPATCHED: set = set()
 
 
 def _dispatch_key(arrays: dict, n_zones: int, weights: Weights,
-                  feats: Features) -> tuple:
+                  feats: Features, explain: bool = False) -> tuple:
     shapes = tuple(sorted((k, tuple(v.shape), str(v.dtype))
                           for k, v in arrays.items()))
-    return shapes, n_zones, weights, feats
+    return shapes, n_zones, weights, feats, explain
 
 
 def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
-             stage=None) -> np.ndarray:
+             stage=None, explain: bool = False):
     """Run the jit'd solve with host materialization as the sync barrier.
 
     `stage(name, fn)` (the watchdog/span hook, ops/watchdog.run_stages) sees
@@ -782,16 +919,17 @@ def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
     from kubernetes_tpu.observability import profiling
     from kubernetes_tpu.utils import platform as plat
 
-    key = _dispatch_key(arrays, n_zones, weights, feats)
+    key = _dispatch_key(arrays, n_zones, weights, feats, explain)
     first = key not in _DISPATCHED
     name = "compile" if first else "solve"
 
     def _run():
         before = plat.compile_cache_snapshot() if first else None
         t0 = _time.perf_counter()
-        pending = _schedule_jit(arrays, n_zones, weights, feats)
+        pending = _schedule_jit(arrays, n_zones, weights, feats, explain)
         t_host = _time.perf_counter()
-        out = np.asarray(pending)  # device execution + D2H, the sync barrier
+        # device execution + D2H, the sync barrier (every leaf when explain)
+        out = jax.tree_util.tree_map(np.asarray, pending)
         profiling.record_dispatch(name, t_host - t0,
                                   _time.perf_counter() - t_host)
         if first:
@@ -805,9 +943,11 @@ def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
 
 
 def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
-                   device=None, stage=None) -> List[Optional[str]]:
+                   device=None, stage=None, explain: bool = False):
     """Schedule a tensorized batch; returns node name (or None) per pending
-    pod, FIFO order."""
+    pod, FIFO order. With explain, returns (names, decision records) — the
+    records carry per-predicate survivor counts and winner/runner-up score
+    decompositions decoded by observability/explain.py."""
     weights = weights or Weights()
     feats = features_of(ct)
     run = stage or (lambda _n, fn: fn())
@@ -830,5 +970,11 @@ def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
         return arrays
 
     arrays = run("upload", _upload)
-    out = dispatch(arrays, ct.n_zones, weights, feats, stage=stage)
-    return assignments_to_names(out, ct)
+    out = dispatch(arrays, ct.n_zones, weights, feats, stage=stage,
+                   explain=explain)
+    if not explain:
+        return assignments_to_names(out, ct)
+    out, extras = out
+    names = assignments_to_names(out, ct)
+    from kubernetes_tpu.observability.explain import decode_batch
+    return names, decode_batch(ct, out, extras, weights, feats)

@@ -128,12 +128,14 @@ def oracle_batch(nodes: List[api.Node], existing: List[api.Pod],
 def tpu_batch(nodes: List[api.Node], existing: List[api.Pod],
               pending: List[api.Pod], args: PluginArgs,
               weights: Optional[Weights] = None,
-              stage=None) -> List[Optional[str]]:
+              stage=None, explain: bool = False):
     """The TPU path: tensorize + device kernel. `stage(name, fn)` is the
     watchdog/span hook (ops/watchdog.run_stages) naming the pipeline stages
-    tensorize -> upload -> compile|solve."""
+    tensorize -> upload -> compile|solve. With explain, returns
+    (names, DecisionRecords) — per-predicate provenance straight from the
+    solve (observability/explain.py)."""
     run = stage or (lambda _n, fn: fn())
     ct = run("tensorize",
              lambda: Tensorizer(plugin_args=args).build(nodes, existing,
                                                         pending))
-    return schedule_batch(ct, weights, stage=stage)
+    return schedule_batch(ct, weights, stage=stage, explain=explain)

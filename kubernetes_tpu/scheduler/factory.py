@@ -15,6 +15,7 @@ scheduler.go (156 ln):
 
 from __future__ import annotations
 
+import heapq
 import logging
 import threading
 import time
@@ -191,14 +192,15 @@ class ConfigFactory:
     def create_batch_from_provider(self, provider_name: str = DEFAULT_PROVIDER,
                                    batch_size: int = 4096, weights=None,
                                    strict: bool = False,
-                                   stage_deadlines=None):
+                                   stage_deadlines=None, explain=None):
         """The TPU-backed batch scheduler (scheduler/tpu.py) with the oracle
         from the same provider as its device-failure fallback."""
         from kubernetes_tpu.scheduler.tpu import create_batch_scheduler
         return create_batch_scheduler(self, provider_name,
                                       batch_size=batch_size, weights=weights,
                                       strict=strict,
-                                      stage_deadlines=stage_deadlines)
+                                      stage_deadlines=stage_deadlines,
+                                      explain=explain)
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -217,6 +219,60 @@ class ConfigFactory:
             inf.stop()
 
 
+class _RequeueWorker:
+    """ONE daemon delay-worker draining a heap of (due, seq, pod) — the
+    backoff-requeue machinery for every failed pod.  The previous
+    thread-per-failure scheme minted 30k threads for 30k unschedulable
+    pods; this is bounded at one thread regardless of backlog.
+
+    The heap is mutated only under the condition lock; the fire callback
+    (a GET + FIFO re-add) runs with NO lock held."""
+
+    def __init__(self, fire: Callable, stop: threading.Event):
+        self._fire = fire
+        self._stop = stop
+        self._cv = threading.Condition()
+        self._heap: list = []
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def add(self, delay: float, pod) -> None:
+        with self._cv:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="scheduler-requeue", daemon=True)
+                self._thread.start()
+            heapq.heappush(self._heap,
+                           (time.monotonic() + delay, self._seq, pod))
+            self._seq += 1
+            self._cv.notify()
+
+    def wake(self) -> None:
+        with self._cv:
+            self._cv.notify()
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._heap)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cv:
+                if not self._heap:
+                    self._cv.wait(0.5)
+                    continue
+                due = self._heap[0][0]
+                now = time.monotonic()
+                if due > now:
+                    self._cv.wait(min(due - now, 0.5))
+                    continue
+                _, _, pod = heapq.heappop(self._heap)
+            try:
+                self._fire(pod)
+            except Exception:
+                log.exception("requeue fire failed")
+
+
 class Scheduler:
     """The loop (scheduler.go:89-155)."""
 
@@ -227,6 +283,12 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._cleanup_thread: Optional[threading.Thread] = None
+        self._requeue = _RequeueWorker(self._requeue_now, self._stop)
+        # pod key -> score-breakdown text for the IN-FLIGHT bind, consumed
+        # exactly once by _bind. Populated only by the kernel batch path for
+        # the decision that produced this bind — a later fallback rebind
+        # must not inherit a stale kernel record's provenance.
+        self._bind_notes: dict = {}
 
     # --- one decision (scheduleOne, scheduler.go:93) -------------------------
 
@@ -293,6 +355,9 @@ class Scheduler:
             # transport errors too — a dead bind thread with no rollback
             # would strand the pod booked-but-unbound until TTL expiry
             log.warning("binding failed for %s: %s", pod.metadata.name, e)
+            # this decision's provenance dies with its bind: a later retry
+            # is a NEW decision and must not inherit the note
+            self._bind_notes.pop(key, None)
             if did_assume:
                 # roll our own assume back; never evict informer-confirmed
                 # state booked by an earlier successful bind
@@ -302,16 +367,28 @@ class Scheduler:
         METRICS.observe("scheduler_e2e_scheduling_latency_seconds",
                         time.perf_counter() - t_start)
         self.f.spans.finish(key)
-        self.recorder.event(pod, "Normal", "Scheduled",
-                            f"Successfully assigned {pod.metadata.name} to {dest}")
+        msg = f"Successfully assigned {pod.metadata.name} to {dest}"
+        # decision provenance (kernel explain path): the score breakdown
+        # rides the Scheduled event so `kubectl describe pod` can render a
+        # Scheduling section without any new API surface
+        note = self._bind_notes.pop(key, None)
+        if note:
+            msg += f" [{note}]"
+        self.recorder.event(pod, "Normal", "Scheduled", msg)
 
     def _handle_failure(self, pod: api.Pod, err: Exception):
         """Error func: event + condition + backoff requeue
         (scheduler.go:102-107, factory.go:503-539)."""
+        from kubernetes_tpu.observability.explain import note_unschedulable
         log.info("failed to schedule %s: %s", pod.metadata.name, err)
         root = self.f.spans.finish(
             f"{pod.metadata.namespace}/{pod.metadata.name}", error=str(err))
-        self.recorder.event(pod, "Warning", "FailedScheduling", str(err))
+        # signature = the elimination histogram's shape (kernel decisions):
+        # retries whose per-predicate counts drift with churn still dedup
+        # onto ONE FailedScheduling Event instead of minting new objects
+        self.recorder.event(pod, "Warning", "FailedScheduling", str(err),
+                            signature=getattr(err, "signature", None))
+        note_unschedulable(err)
         try:
             # status write under the pod's (just-finished) span: the audit
             # trail ties the Unschedulable PUT to the failed attempt's trace
@@ -320,23 +397,26 @@ class Scheduler:
                     "PUT",
                     f"/api/v1/namespaces/{pod.metadata.namespace}/pods/{pod.metadata.name}/status",
                     _status_with_condition(pod, "Unschedulable", str(err)))
-        except ApiError:
-            pass
+        except ApiError as e:
+            # a pod whose Unschedulable verdict never lands looks healthy to
+            # every API consumer — this failure must be visible
+            log.warning("Unschedulable status write failed for %s/%s: %s",
+                        pod.metadata.namespace, pod.metadata.name, e)
+            METRICS.inc("scheduler_status_write_errors_total")
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
-        delay = self.f.backoff.next(key)
+        self._requeue.add(self.f.backoff.next(key), pod)
 
-        def requeue():
-            if self._stop.wait(delay):
-                return
-            try:
-                fresh = self.f.client.get("pods", pod.metadata.name,
-                                          pod.metadata.namespace)
-            except ApiError:
-                return  # deleted meanwhile
-            if not (fresh.spec and fresh.spec.node_name):
-                self.f.pending.add_if_not_present(fresh)
-
-        threading.Thread(target=requeue, daemon=True).start()
+    def _requeue_now(self, pod: api.Pod) -> None:
+        """Delay-worker fire: refetch and re-queue if still unassigned."""
+        if self._stop.is_set():
+            return
+        try:
+            fresh = self.f.client.get("pods", pod.metadata.name,
+                                      pod.metadata.namespace)
+        except ApiError:
+            return  # deleted meanwhile
+        if not (fresh.spec and fresh.spec.node_name):
+            self.f.pending.add_if_not_present(fresh)
 
     # --- loop ----------------------------------------------------------------
 
@@ -363,6 +443,7 @@ class Scheduler:
 
     def stop(self):
         self._stop.set()
+        self._requeue.wake()
         if self._thread:
             self._thread.join(timeout=5)
 

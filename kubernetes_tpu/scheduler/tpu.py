@@ -57,6 +57,7 @@ make a stuck drain attributable to the exact stage.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 import traceback
@@ -119,10 +120,19 @@ class BatchScheduler(Scheduler):
                  retry_initial: float = 1.0, retry_max: float = 60.0,
                  bug_cooldown: float = 300.0, clock=time.monotonic,
                  incremental: bool = True,
-                 stage_deadlines: Optional[dict] = None):
+                 stage_deadlines: Optional[dict] = None,
+                 explain: Optional[bool] = None):
         super().__init__(factory, algorithm)
         self.batch_size = batch_size
         self.weights = weights or Weights()
+        # per-predicate decision provenance from the solve (ISSUE 12): the
+        # kernel emits survivor counts + score decompositions, decoded into
+        # the DecisionLedger / FailedScheduling breakdowns. Default on;
+        # KTPU_EXPLAIN=0 opts out (assignments are bit-identical either way
+        # — the flag only adds reductions to the traced program).
+        self.explain = (explain if explain is not None
+                        else os.environ.get("KTPU_EXPLAIN", "1") != "0")
+        self._last_explain = None
         # per-stage watchdog deadlines (tensorize/upload/compile/solve): a
         # hang becomes a StageTimeout + scheduler_stage_timeout_total tick
         # and takes the device-error fallback path, never a silent wedge
@@ -313,12 +323,33 @@ class BatchScheduler(Scheduler):
 
         self._on_kernel_success()
         self.kernel_batches += 1
+        records, self._last_explain = (self._last_explain or []), None
+        recmap = {}
+        if records:
+            from kubernetes_tpu.observability.explain import LEDGER
+            for rec in records:
+                LEDGER.add(rec)
+            recmap = {r.pod: r for r in records}
         for pod, dest in zip(pods, results):
+            key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            rec = recmap.get(key)
             if dest is None:
-                self._handle_failure(pod, FitError(pod, {
-                    "*": "kernel: no feasible node in batch"}))
+                if rec is not None:
+                    from kubernetes_tpu.observability.explain import (
+                        KernelFitError,
+                    )
+                    err: FitError = KernelFitError(pod, rec)
+                else:
+                    err = FitError(pod, {
+                        "*": "kernel: no feasible node in batch"})
+                self._handle_failure(pod, err)
                 continue
             self.kernel_pods += 1
+            if rec is not None and rec.node == dest:
+                from kubernetes_tpu.observability.explain import (
+                    format_assigned,
+                )
+                self._bind_notes[key] = format_assigned(rec)
             self._assume_and_bind(pod, dest, t_start)
         return len(pods)
 
@@ -329,17 +360,25 @@ class BatchScheduler(Scheduler):
         exported as a scheduler_stage_seconds series + a child span of the
         batch span."""
         batch_span = getattr(self, "_batch_span", None)
+        explain = self.explain
+        self._last_explain = None
         if self._inc is not None:
             inc = self._inc
-            return run_stages(
-                lambda stage: inc.schedule(pending, self.weights, stage=stage),
+            ret = run_stages(
+                lambda stage: inc.schedule(pending, self.weights, stage=stage,
+                                           explain=explain),
                 deadlines=self.stage_deadlines, span=batch_span)
-        from kubernetes_tpu.scheduler.batch import tpu_batch
-        return run_stages(
-            lambda stage: tpu_batch(nodes, existing, pending,
-                                    self.f.plugin_args, self.weights,
-                                    stage=stage),
-            deadlines=self.stage_deadlines, span=batch_span)
+        else:
+            from kubernetes_tpu.scheduler.batch import tpu_batch
+            ret = run_stages(
+                lambda stage: tpu_batch(nodes, existing, pending,
+                                        self.f.plugin_args, self.weights,
+                                        stage=stage, explain=explain),
+                deadlines=self.stage_deadlines, span=batch_span)
+        if explain and isinstance(ret, tuple):
+            results, self._last_explain = ret
+            return results
+        return ret
 
     def resync_incremental(self):
         """Drop and re-mirror the incremental state from the cache — the
@@ -380,7 +419,8 @@ def create_batch_scheduler(factory: ConfigFactory,
                            batch_size: int = 4096,
                            weights: Optional[Weights] = None,
                            strict: bool = False,
-                           stage_deadlines: Optional[dict] = None
+                           stage_deadlines: Optional[dict] = None,
+                           explain: Optional[bool] = None
                            ) -> BatchScheduler:
     """Build a BatchScheduler whose fallback algorithm is the oracle built
     from the same provider (CreateFromProvider seam, factory.go:248-342)."""
@@ -394,4 +434,4 @@ def create_batch_scheduler(factory: ConfigFactory,
     algorithm = GenericScheduler(predicates, priorities)
     return BatchScheduler(factory, algorithm, batch_size=batch_size,
                           weights=weights, strict=strict,
-                          stage_deadlines=stage_deadlines)
+                          stage_deadlines=stage_deadlines, explain=explain)

@@ -67,6 +67,16 @@ def debug_route(path: str, healthz: Callable[[], bool],
         n = (query.get("n") or [None])[0]
         return (200, json.dumps(render_auditz(AUDIT, n)).encode(),
                 "application/json")
+    if path == "/explainz":
+        # the scheduler decision ledger: per-pod why/why-not provenance
+        # (?pod=ns/name for one pod's latest decision, ?n= for the tail)
+        from kubernetes_tpu.observability.explain import (
+            LEDGER, render_explainz,
+        )
+        pod = (query.get("pod") or [None])[0]
+        n = (query.get("n") or [None])[0]
+        return (200, json.dumps(render_explainz(LEDGER, pod=pod, n=n)).encode(),
+                "application/json")
     return None
 
 

@@ -106,10 +106,20 @@ class EventCorrelator:
             cache.popitem(last=False)
 
     def correlate(self, source_key: Tuple, similarity_key: Tuple,
-                  message: str) -> Optional[Tuple[Tuple, str, bool]]:
+                  message: str,
+                  signature: Optional[Tuple] = None
+                  ) -> Optional[Tuple[Tuple, str, bool]]:
         """Returns (dedup key, message to record, aggregated?) — or None when
-        the spam filter drops the event."""
+        the spam filter drops the event.
+
+        `signature` (optional) replaces the raw message in BOTH the dedup
+        identity and the distinct-variant count: events whose messages
+        differ but share a signature (e.g. the scheduler's per-predicate
+        elimination histogram SHAPE, whose counts drift as the cluster
+        churns) bump one Event's count instead of minting new objects —
+        richer ledger-derived messages must not defeat the storm dedup."""
         now = self._clock()
+        variant = signature if signature is not None else message
         with self._lock:
             tokens, last = self._spam.get(source_key, (self._spam_burst, now))
             tokens = min(self._spam_burst,
@@ -126,7 +136,7 @@ class EventCorrelator:
             if rec is None or now - rec[1] > self._similar_interval:
                 rec = [set(), now]
             if len(rec[0]) <= self._max_similar:
-                rec[0].add(message)
+                rec[0].add(variant)
             self._similar[similarity_key] = rec
             self._similar.move_to_end(similarity_key)
             self._cap(self._similar)
@@ -134,7 +144,7 @@ class EventCorrelator:
                 # storm of similar events: they all collapse onto ONE
                 # aggregate identity regardless of message
                 return similarity_key, AGGREGATED_PREFIX + message, True
-            return similarity_key + (message,), message, False
+            return similarity_key + (variant,), message, False
 
 
 class EventRecorder:
@@ -158,12 +168,13 @@ class EventRecorder:
         self._started = False
         self._lock = threading.Lock()
 
-    def event(self, obj, etype: str, reason: str, message: str):
+    def event(self, obj, etype: str, reason: str, message: str,
+              signature: Optional[Tuple] = None):
         with self._lock:
             if not self._started:
                 self._thread.start()
                 self._started = True
-        self._q.put((obj, etype, reason, message))
+        self._q.put((obj, etype, reason, message, signature))
 
     def flush(self, timeout: float = 5.0):
         """Best-effort wait for queued events to be posted (tests)."""
@@ -173,13 +184,14 @@ class EventRecorder:
 
     def _pump(self):
         while True:
-            obj, etype, reason, message = self._q.get()
+            obj, etype, reason, message, signature = self._q.get()
             try:
-                self._record(obj, etype, reason, message)
+                self._record(obj, etype, reason, message, signature)
             except Exception as e:
                 log.warning("event post failed: %s", e)
 
-    def _record(self, obj, etype: str, reason: str, message: str):
+    def _record(self, obj, etype: str, reason: str, message: str,
+                signature: Optional[Tuple] = None):
         meta = obj.metadata
         ref = api.ObjectReference(
             kind=type(obj).__name__, namespace=meta.namespace, name=meta.name,
@@ -187,7 +199,8 @@ class EventRecorder:
         source_key = (self.source.component, self.source.host,
                       ref.kind, ref.namespace, ref.name, ref.uid)
         similarity_key = (ref.kind, ref.namespace, ref.name, etype, reason)
-        hit = self.correlator.correlate(source_key, similarity_key, message)
+        hit = self.correlator.correlate(source_key, similarity_key, message,
+                                        signature=signature)
         if hit is None:
             METRICS.inc("events_discarded_total",
                         component=self.source.component)

@@ -1,0 +1,624 @@
+"""Scheduler decision ledger: per-predicate explainability (ISSUE 12).
+
+The correctness anchor is oracle equivalence: on randomized fixtures the
+kernel's per-predicate surviving-node counts and winner/runner-up score
+decompositions must match a node-by-node replay of the Python
+scheduler/predicates.py + priorities.py (observability/explain.py
+oracle_breakdown) EXACTLY — and explain=off must stay bit-identical to the
+plain solve.  Plus the delivery surfaces: reason-string formatting,
+/explainz over live HTTP, ledger pruning, flight-recorder decisions,
+signature-based event dedup, and the single requeue delay-worker.
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.observability.explain import (
+    LEDGER, PREDICATES, DecisionLedger, DecisionRecord, KernelFitError,
+    format_assigned, format_reason, note_unschedulable, oracle_breakdown,
+    reason_signature, render_explainz,
+)
+from kubernetes_tpu.scheduler.batch import (
+    ListPodLister, ListServiceLister, make_plugin_args, tpu_batch,
+)
+
+
+def mk_node(name, cpu="4", mem="32Gi", pods="110", labels=None, taints=None,
+            conditions=None):
+    labels = dict(labels or {})
+    labels.setdefault(api.LABEL_HOSTNAME, name)
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels),
+        spec=api.NodeSpec(taints=taints),
+        status=api.NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": pods},
+            conditions=conditions or [api.NodeCondition(type="Ready",
+                                                        status="True")]))
+
+
+def mk_pod(name, ns="default", cpu=None, mem=None, labels=None, node="",
+           selector=None, affinity=None, tolerations=None, host_ports=()):
+    requests = {}
+    if cpu:
+        requests["cpu"] = cpu
+    if mem:
+        requests["memory"] = mem
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(
+            node_name=node, node_selector=selector, affinity=affinity,
+            tolerations=tolerations,
+            containers=[api.Container(
+                name="c", image="pause",
+                ports=[api.ContainerPort(host_port=p, container_port=p)
+                       for p in host_ports],
+                resources=api.ResourceRequirements(requests=requests)
+                if requests else None)]))
+
+
+def _assert_records_equal(kr, orr):
+    assert kr.pod == orr.pod
+    assert kr.nodes_total == orr.nodes_total
+    assert kr.survivors == orr.survivors, (
+        f"{kr.pod}: survivors {kr.survivors} != oracle {orr.survivors}\n"
+        f"kernel elim {dict(kr.eliminations())} "
+        f"oracle elim {dict(orr.eliminations())}")
+    assert kr.node == orr.node
+    if kr.node is None:
+        return
+    assert kr.score == pytest.approx(orr.score, abs=1e-4), kr.pod
+    assert set(kr.components) == set(orr.components), kr.pod
+    for name in orr.components:
+        assert kr.components[name] == pytest.approx(
+            orr.components[name], abs=1e-4), (kr.pod, name)
+    assert kr.runner_up == orr.runner_up, kr.pod
+    if kr.runner_up is not None:
+        assert kr.runner_up_score == pytest.approx(
+            orr.runner_up_score, abs=1e-4), kr.pod
+        for name in orr.runner_up_components:
+            assert kr.runner_up_components[name] == pytest.approx(
+                orr.runner_up_components[name], abs=1e-4), (kr.pod, name)
+
+
+class TestKernelOracleParity:
+    """The acceptance anchor: kernel explain output == Python replay."""
+
+    def _random_cluster(self, seed):
+        rng = random.Random(seed)
+        zones = ["us-a", "us-b", "us-c"]
+        nodes = []
+        for i in range(20):
+            labels = {api.LABEL_HOSTNAME: f"n{i:02d}",
+                      api.LABEL_ZONE: rng.choice(zones)}
+            if rng.random() < 0.3:
+                labels["disk"] = "ssd"
+            taints = None
+            r = rng.random()
+            if r < 0.15:
+                taints = [api.Taint(key="ded", value="ml",
+                                    effect="NoSchedule")]
+            elif r < 0.3:
+                taints = [api.Taint(key="soft", value="x",
+                                    effect="PreferNoSchedule")]
+            nodes.append(mk_node(
+                f"n{i:02d}", cpu=rng.choice(["2", "4"]),
+                mem=rng.choice(["8Gi", "16Gi"]),
+                pods=str(rng.choice([4, 110])), labels=labels, taints=taints))
+        existing = []
+        for i in range(12):
+            existing.append(mk_pod(
+                f"e{i:02d}", cpu="500m", mem="1Gi",
+                labels={"app": rng.choice(["web", "db"])},
+                node=rng.choice(nodes).metadata.name))
+        svc = api.Service(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.ServiceSpec(selector={"app": "web"},
+                                 ports=[api.ServicePort(port=80)]))
+        pending = []
+        for i in range(40):
+            kw = {"cpu": f"{rng.choice([100, 500, 1500])}m", "mem": "256Mi",
+                  "labels": {"app": rng.choice(["web", "db"])}}
+            r = rng.random()
+            if r < 0.2:
+                kw["selector"] = {"disk": "ssd"}
+            elif r < 0.3:
+                kw["tolerations"] = [api.Toleration(key="ded",
+                                                    operator="Exists")]
+            elif r < 0.4:
+                kw["host_ports"] = (9000 + (i % 3),)
+            elif r < 0.5:
+                kw["affinity"] = api.Affinity(
+                    node_affinity=api.NodeAffinity(
+                        preferred_during_scheduling_ignored_during_execution=[
+                            api.PreferredSchedulingTerm(
+                                weight=10,
+                                preference=api.NodeSelectorTerm(
+                                    match_expressions=[
+                                        api.NodeSelectorRequirement(
+                                            key="disk", operator="In",
+                                            values=["ssd"])]))]))
+            elif r < 0.6:
+                kw["affinity"] = api.Affinity(
+                    pod_anti_affinity=api.PodAntiAffinity(
+                        required_during_scheduling_ignored_during_execution=[
+                            api.PodAffinityTerm(
+                                label_selector=api.LabelSelector(
+                                    match_labels={"uniq": f"u{i}"}),
+                                topology_key=api.LABEL_ZONE)]))
+                kw["labels"]["uniq"] = f"u{i}"
+            pending.append(mk_pod(f"p{i:02d}", **kw))
+        # seeded hopeless pods: every breakdown bucket is exercised somewhere
+        pending.append(mk_pod("huge", cpu="64"))
+        pending.append(mk_pod("nosel", selector={"disk": "nvme"}))
+
+        def args():
+            return make_plugin_args(
+                nodes, pod_lister=ListPodLister(list(existing)),
+                service_lister=ListServiceLister([svc]))
+        return nodes, existing, pending, args
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_randomized_parity(self, seed):
+        nodes, existing, pending, args = self._random_cluster(seed)
+        names, recs = tpu_batch(nodes, existing, pending, args(),
+                                explain=True)
+        orecs = oracle_breakdown(nodes, existing, pending, args(), names)
+        assert len(recs) == len(orecs) == len(pending)
+        assert any(r.node is None for r in recs)
+        assert any(r.node is not None for r in recs)
+        for kr, orr in zip(recs, orecs):
+            _assert_records_equal(kr, orr)
+
+    def test_explain_off_bit_identical(self):
+        nodes, existing, pending, args = self._random_cluster(3)
+        plain = tpu_batch(nodes, existing, pending, args())
+        names, recs = tpu_batch(nodes, existing, pending, args(),
+                                explain=True)
+        assert names == plain
+        # and the records name the same assignments
+        assert [r.node for r in recs] == plain
+
+    def test_seeded_unschedulable_exact_counts(self):
+        """One pod, four nodes, four distinct elimination reasons."""
+        nodes = [
+            mk_node("n0"),                                     # no ssd label
+            mk_node("n1", labels={"disk": "ssd"},
+                    taints=[api.Taint(key="ded", value="x",
+                                      effect="NoSchedule")]),  # untolerated
+            mk_node("n2", cpu="1", labels={"disk": "ssd"}),    # cpu-full
+            mk_node("n3", labels={"disk": "ssd"}),             # port clash
+        ]
+        existing = [mk_pod("hog", cpu="900m", node="n2"),
+                    mk_pod("porter", node="n3", host_ports=(9000,))]
+        pending = [mk_pod("p", cpu="200m", selector={"disk": "ssd"},
+                          host_ports=(9000,))]
+        args = make_plugin_args(nodes,
+                                pod_lister=ListPodLister(list(existing)))
+        names, recs = tpu_batch(nodes, existing, pending, args, explain=True)
+        assert names == [None]
+        rec = recs[0]
+        assert dict(rec.eliminations()) == {
+            "MatchNodeSelector": 1, "PodToleratesNodeTaints": 1,
+            "InsufficientCPU": 1, "PodFitsHostPorts": 1}
+        assert format_reason(rec) == (
+            "0/4 nodes are available: 1 Insufficient cpu, "
+            "1 MatchNodeSelector, 1 PodFitsHostPorts, "
+            "1 PodToleratesNodeTaints.")
+        assert reason_signature(rec) == (
+            "InsufficientCPU", "MatchNodeSelector", "PodFitsHostPorts",
+            "PodToleratesNodeTaints")
+
+    def test_reasons_counter_from_kernel_and_fiterror(self):
+        from kubernetes_tpu.scheduler.generic import FitError
+        from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+        rec = DecisionRecord(
+            pod="default/p", node=None, nodes_total=5,
+            survivors=(3, 3, 3, 3, 3, 3, 0, 0, 0, 0, 0, 0, 0))
+        pod = mk_pod("p")
+        before = dict(METRICS.counter_series(
+            "scheduler_unschedulable_reasons_total"))
+        note_unschedulable(KernelFitError(pod, rec))
+        note_unschedulable(FitError(pod, {
+            "n1": "PodFitsResources: Insufficient cpu",
+            "n2": "PodFitsResources: Insufficient cpu",
+            "n3": "free text with no predicate key",
+            "n4": "another node-specific reason n4"}))
+        after = METRICS.counter_series("scheduler_unschedulable_reasons_total")
+
+        def delta(pred):
+            k = (("predicate", pred),)
+            return after.get(k, 0.0) - before.get(k, 0.0)
+        assert delta("MatchNodeSelector") == 2.0
+        assert delta("InsufficientCPU") == 3.0
+        assert delta("PodFitsResources") == 2.0
+        # free-text reasons bucket into ONE label, never per-node series
+        assert delta("Other") == 2.0
+
+
+class TestReasonFormatting:
+    def test_reference_style_breakdown(self):
+        # "0/5000 nodes are available: 3200 Insufficient cpu,
+        #  1800 MatchNodeSelector." — counts descending
+        surv = [3200] * 6 + [0] * 7
+        rec = DecisionRecord(pod="default/p", node=None, nodes_total=5000,
+                             survivors=tuple(surv))
+        assert format_reason(rec) == (
+            "0/5000 nodes are available: 3200 Insufficient cpu, "
+            "1800 MatchNodeSelector.")
+
+    def test_all_rows_named(self):
+        # one elimination per canonical row formats without KeyErrors
+        n = len(PREDICATES)
+        surv = tuple(n - i - 1 for i in range(n))
+        rec = DecisionRecord(pod="d/p", node=None, nodes_total=n,
+                             survivors=surv)
+        msg = format_reason(rec)
+        assert msg.startswith(f"0/{n} nodes are available: ")
+        assert msg.count("1 ") == n
+
+    def test_assigned_summary(self):
+        rec = DecisionRecord(
+            pod="default/p", node="n1", nodes_total=5,
+            survivors=(5,) * 13, score=37.0,
+            components={"least_requested": 7.0, "spread": 10.0},
+            runner_up="n2", runner_up_score=36.0,
+            runner_up_components={"least_requested": 6.0, "spread": 10.0})
+        assert format_assigned(rec) == (
+            "score 37 (least_requested=7 spread=10); "
+            "runner-up n2 score 36")
+        d = rec.to_dict()
+        assert d["summary"] == format_assigned(rec)
+        assert d["runner_up"] == "n2"
+
+    def test_no_survivor_rows(self):
+        rec = DecisionRecord(pod="d/p", node=None, nodes_total=0,
+                             survivors=(0,) * 13)
+        assert "no schedulable nodes" in format_reason(rec)
+
+
+class TestDecisionLedger:
+    def test_pruning_and_index(self):
+        led = DecisionLedger(capacity=8)
+        for i in range(20):
+            led.add(DecisionRecord(pod=f"d/p{i}", node="n", nodes_total=1,
+                                   survivors=(1,) * 13))
+        assert len(led) == 8
+        assert led.get("d/p0") is None          # evicted, index pruned
+        assert led.get("d/p19") is not None
+        tail = led.tail(4)
+        assert [r.pod for r in tail] == ["d/p16", "d/p17", "d/p18", "d/p19"]
+        assert led.tail(0) == []                # -0 slice must not mean "all"
+        assert led.tail(-3) == []
+
+    def test_latest_decision_wins(self):
+        led = DecisionLedger(capacity=8)
+        led.add(DecisionRecord(pod="d/p", node=None, nodes_total=1,
+                               survivors=(0,) * 13))
+        led.add(DecisionRecord(pod="d/p", node="n1", nodes_total=1,
+                               survivors=(1,) * 13))
+        assert led.get("d/p").node == "n1"
+
+    def test_render_explainz(self):
+        led = DecisionLedger(capacity=8)
+        led.add(DecisionRecord(pod="d/p", node=None, nodes_total=3,
+                               survivors=(0,) * 13))
+        out = render_explainz(led)
+        assert out["size"] == 1 and len(out["decisions"]) == 1
+        assert out["decisions"][0]["reason"].startswith("0/3 nodes")
+        one = render_explainz(led, pod="d/p")
+        assert one["decision"]["pod"] == "d/p"
+        assert render_explainz(led, pod="d/unknown")["decision"] is None
+        assert render_explainz(led, n="bogus")["size"] == 1  # tolerant n=
+
+
+class TestExplainzHTTP:
+    def test_live_endpoint(self):
+        from kubernetes_tpu.utils.debugserver import DebugServer
+        LEDGER.clear()
+        LEDGER.add(DecisionRecord(pod="default/web-1", node="n7",
+                                  nodes_total=9, survivors=(9,) * 13,
+                                  score=30.0, components={"spread": 10.0}))
+        LEDGER.add(DecisionRecord(pod="default/web-2", node=None,
+                                  nodes_total=9, survivors=(0,) * 13))
+        srv = DebugServer(port=0).start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}{path}", timeout=5) as r:
+                    return json.loads(r.read())
+            out = get("/explainz")
+            assert out["size"] == 2
+            assert [d["pod"] for d in out["decisions"]] == [
+                "default/web-1", "default/web-2"]
+            one = get("/explainz?pod=default/web-1")
+            assert one["decision"]["node"] == "n7"
+            assert get("/explainz?n=1")["decisions"][0]["pod"] == \
+                "default/web-2"
+        finally:
+            srv.stop()
+            LEDGER.clear()
+
+
+class TestFlightRecorderDecisions:
+    def test_bundle_carries_ledger_tail(self, tmp_path):
+        from kubernetes_tpu.observability.flightrecorder import FlightRecorder
+        LEDGER.clear()
+        LEDGER.add(DecisionRecord(pod="default/stuck", node=None,
+                                  nodes_total=4, survivors=(0,) * 13))
+        rec = FlightRecorder(directory=str(tmp_path))
+        path = rec.dump("test-wedge", trigger={"why": "test"})
+        try:
+            with open(path, encoding="utf-8") as f:
+                bundle = json.load(f)
+            assert isinstance(bundle["decisions"], list)
+            assert bundle["decisions"][-1]["pod"] == "default/stuck"
+            assert bundle["decisions"][-1]["reason"].startswith("0/4 nodes")
+        finally:
+            LEDGER.clear()
+
+
+class TestEventSignature:
+    def test_signature_joins_dedup_identity(self):
+        from kubernetes_tpu.utils.events import EventCorrelator
+        c = EventCorrelator(clock=lambda: 0.0)
+        src = ("scheduler", "", "Pod", "default", "p", "")
+        sim = ("Pod", "default", "p", "Warning", "FailedScheduling")
+        k1 = c.correlate(src, sim, "0/5: 3 X, 2 Y", signature=("X", "Y"))
+        k2 = c.correlate(src, sim, "0/5: 2 X, 3 Y", signature=("X", "Y"))
+        # drifting counts, same histogram shape: ONE dedup identity (count
+        # bump), with the newer message carried for the update
+        assert k1[0] == k2[0]
+        assert k2[1] == "0/5: 2 X, 3 Y"
+        k3 = c.correlate(src, sim, "0/5: 5 Z", signature=("Z",))
+        assert k3[0] != k1[0]
+
+    def test_signature_storms_still_aggregate(self):
+        from kubernetes_tpu.utils.events import (
+            AGGREGATED_PREFIX, EventCorrelator,
+        )
+        c = EventCorrelator(clock=lambda: 0.0, max_similar=3)
+        src = ("scheduler", "", "Pod", "default", "p", "")
+        sim = ("Pod", "default", "p", "Warning", "FailedScheduling")
+        last = None
+        for i in range(6):
+            last = c.correlate(src, sim, f"msg {i}", signature=(f"sig{i}",))
+        assert last is not None and last[2] is True
+        assert last[1].startswith(AGGREGATED_PREFIX)
+
+    def test_plain_messages_unchanged(self):
+        from kubernetes_tpu.utils.events import EventCorrelator
+        c = EventCorrelator(clock=lambda: 0.0)
+        src = ("kubelet", "", "Pod", "default", "p", "")
+        sim = ("Pod", "default", "p", "Normal", "Pulled")
+        k1 = c.correlate(src, sim, "pulled image")
+        k2 = c.correlate(src, sim, "pulled image")
+        k3 = c.correlate(src, sim, "pulled other")
+        assert k1[0] == k2[0] and k3[0] != k1[0]
+
+
+class TestRequeueWorker:
+    def test_one_thread_drains_many(self):
+        from kubernetes_tpu.scheduler.factory import _RequeueWorker
+        fired = []
+        stop = threading.Event()
+        w = _RequeueWorker(fired.append, stop)
+        try:
+            for i in range(300):
+                w.add(0.01, i)
+            deadline = time.monotonic() + 10
+            while len(fired) < 300 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(fired) == 300
+            workers = [t for t in threading.enumerate()
+                       if t.name == "scheduler-requeue"]
+            assert len(workers) == 1, (
+                f"{len(workers)} requeue threads for 300 requeues")
+        finally:
+            stop.set()
+            w.wake()
+
+    def test_due_order(self):
+        from kubernetes_tpu.scheduler.factory import _RequeueWorker
+        fired = []
+        stop = threading.Event()
+        w = _RequeueWorker(fired.append, stop)
+        try:
+            w.add(0.30, "late")
+            w.add(0.05, "early")
+            deadline = time.monotonic() + 5
+            while len(fired) < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert fired == ["early", "late"]
+        finally:
+            stop.set()
+            w.wake()
+
+    def test_stop_ends_worker(self):
+        from kubernetes_tpu.scheduler.factory import _RequeueWorker
+        stop = threading.Event()
+        w = _RequeueWorker(lambda pod: None, stop)
+        w.add(30.0, "never")
+        stop.set()
+        w.wake()
+        w._thread.join(timeout=5)
+        assert not w._thread.is_alive()
+
+
+class TestLiveExplainPipeline:
+    """The four-surface acceptance: event, condition, /explainz, describe."""
+
+    @pytest.fixture()
+    def server(self):
+        from kubernetes_tpu.apiserver import APIServer
+        s = APIServer().start()
+        yield s
+        s.stop()
+
+    @pytest.fixture()
+    def client(self, server):
+        from kubernetes_tpu.client import RESTClient
+        return RESTClient.for_server(server, qps=5000, burst=5000)
+
+    def test_all_surfaces_agree(self, client):
+        from kubernetes_tpu.kubectl.cmd import (
+            _describe_lines, _object_events, _scheduling_lines,
+        )
+
+        def scheduling_lines(pod_obj):
+            return _scheduling_lines(
+                "pods", pod_obj, _object_events(client, "pods", pod_obj))
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+        LEDGER.clear()
+        for i in range(3):
+            client.create("nodes", mk_node(f"n{i}", labels={"disk": "ssd"}))
+        for i in range(3):
+            client.create("pods", mk_pod(f"fits-{i}", cpu="100m"))
+        client.create("pods", mk_pod("nofit", selector={"disk": "nvme"}))
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(batch_size=16).run()
+        try:
+            deadline = time.monotonic() + 60
+            cond, bound = None, []
+            while time.monotonic() < deadline:
+                pods, _ = client.list("pods", "default")
+                bound = [p for p in pods if p.spec and p.spec.node_name]
+                nofit = next(p for p in pods
+                             if p.metadata.name == "nofit")
+                cond = next(
+                    (c for c in ((nofit.status.conditions or [])
+                                 if nofit.status else [])
+                     if c.type == api.POD_SCHEDULED
+                     and c.status == api.CONDITION_FALSE), None)
+                if len(bound) >= 3 and cond is not None:
+                    break
+                time.sleep(0.05)
+            assert len(bound) >= 3 and cond is not None
+            assert sched.kernel_failures == 0
+
+            # surface 1: the Unschedulable condition is the breakdown
+            want = cond.message
+            assert want == ("0/3 nodes are available: "
+                            "3 MatchNodeSelector.")
+
+            # surface 2: FailedScheduling event carries the same text (the
+            # recorder posts async — poll, don't sample)
+            sched.recorder.flush()
+            deadline = time.monotonic() + 15
+            failed = []
+            while time.monotonic() < deadline:
+                evs, _ = client.list(
+                    "events", "default",
+                    field_selector="involvedObject.kind=Pod,"
+                                   "involvedObject.name=nofit")
+                failed = [e for e in evs
+                          if e.reason == "FailedScheduling"]
+                if any(e.message == want for e in failed):
+                    break
+                time.sleep(0.05)
+            assert failed and any(e.message == want for e in failed)
+
+            # surface 3: the ledger (what /explainz serves)
+            rec = LEDGER.get("default/nofit")
+            assert rec is not None and format_reason(rec) == want
+            for p in bound:
+                lrec = LEDGER.get(f"default/{p.metadata.name}")
+                assert lrec is not None and lrec.node == p.spec.node_name
+                assert lrec.score is not None and lrec.components
+
+            # surface 4: kubectl describe's Scheduling section
+            nofit = client.get("pods", "nofit", "default")
+            lines = scheduling_lines(nofit)
+            assert lines[0] == "Scheduling:"
+            assert lines[1] == f"  Unschedulable:\t{want}"
+            # a bound pod renders decision + runner-up (from the Scheduled
+            # event the scheduler stamped)
+            sched.recorder.flush()
+            deadline = time.monotonic() + 10
+            dlines = []
+            while time.monotonic() < deadline:
+                p0 = client.get("pods", bound[0].metadata.name, "default")
+                dlines = scheduling_lines(p0)
+                if dlines:
+                    break
+                time.sleep(0.05)
+            assert dlines and dlines[0] == "Scheduling:"
+            assert any(line.startswith("  Decision:\tscore ")
+                       for line in dlines)
+            assert _describe_lines("pods", p0)  # smoke: still renders
+
+            # requeue machinery: ONE delay-worker thread, not one per pod
+            requeue_threads = [t for t in threading.enumerate()
+                               if t.name == "scheduler-requeue"]
+            assert len(requeue_threads) <= 1
+        finally:
+            sched.stop()
+            factory.stop()
+            LEDGER.clear()
+
+    def test_explain_off_plain_failure_path(self, client):
+        """KTPU_EXPLAIN off: scheduling still works, generic failure text."""
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+        LEDGER.clear()
+        client.create("nodes", mk_node("n0"))
+        client.create("pods", mk_pod("fits", cpu="100m"))
+        client.create("pods", mk_pod("nofit", selector={"disk": "nvme"}))
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = factory.create_batch_from_provider(
+            batch_size=16, explain=False).run()
+        try:
+            deadline = time.monotonic() + 60
+            cond = None
+            while time.monotonic() < deadline and cond is None:
+                nofit = client.get("pods", "nofit", "default")
+                cond = next(
+                    (c for c in ((nofit.status.conditions or [])
+                                 if nofit.status else [])
+                     if c.type == api.POD_SCHEDULED
+                     and c.status == api.CONDITION_FALSE), None)
+                time.sleep(0.05)
+            assert cond is not None
+            assert "no feasible node in batch" in (cond.message or "")
+            assert LEDGER.get("default/nofit") is None
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_status_write_failure_counted(self, client, monkeypatch, caplog):
+        import logging
+        from kubernetes_tpu.client.rest import ApiError
+        from kubernetes_tpu.scheduler.factory import ConfigFactory, Scheduler
+        from kubernetes_tpu.scheduler.generic import FitError
+        from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+        client.create("nodes", mk_node("n0"))
+        factory = ConfigFactory(client)
+        factory.run()
+        sched = Scheduler(factory, algorithm=None)
+        try:
+            real_request = client.request
+
+            def failing(verb, path, *a, **kw):
+                if verb == "PUT" and path.endswith("/status"):
+                    raise ApiError(503, "ServiceUnavailable", "injected")
+                return real_request(verb, path, *a, **kw)
+
+            monkeypatch.setattr(client, "request", failing)
+            before = METRICS.counter_totals().get(
+                "scheduler_status_write_errors_total", 0.0)
+            pod = mk_pod("doomed")
+            with caplog.at_level(logging.WARNING, logger="scheduler"):
+                sched._handle_failure(pod, FitError(pod, {"n0": "X: nope"}))
+            after = METRICS.counter_totals().get(
+                "scheduler_status_write_errors_total", 0.0)
+            assert after == before + 1
+            assert "Unschedulable status write failed" in caplog.text
+        finally:
+            sched.stop()
+            factory.stop()

@@ -347,7 +347,8 @@ class TestStageWatchdog:
         before = METRICS.counter_value("scheduler_stage_timeout_total",
                                        stage="tensorize")
 
-        def hang_schedule(pending, weights=None, device=None, stage=None):
+        def hang_schedule(pending, weights=None, device=None, stage=None,
+                          **kw):
             return stage("tensorize", lambda: time.sleep(60))
         sched._inc.schedule = hang_schedule
         try:

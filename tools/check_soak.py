@@ -45,11 +45,17 @@ def check(doc: dict, expect_wedged: bool) -> list:
     need(detail, "wedged", lambda v: isinstance(v, bool), "detail", "bool")
     need(detail, "config", lambda v: isinstance(v, dict), "detail", "object")
 
+    def _reasons_ok(v) -> bool:
+        return isinstance(v, dict) and all(
+            isinstance(k, str) and _is_num(n) for k, n in v.items())
+
     for i, rnd in enumerate(detail.get("rounds") or []):
         where = f"detail.rounds[{i}]"
         need(rnd, "created", _is_num, where, "number")
         need(rnd, "bound_in_round", _is_num, where, "number")
         need(rnd, "slos", lambda v: isinstance(v, dict), where, "object")
+        need(rnd, "unschedulable_reasons", _reasons_ok, where,
+             "predicate -> count object (may be empty)")
         for key in ("pods_per_sec", "e2e_p50_seconds", "e2e_p99_seconds"):
             need(rnd, key, lambda v: v is None or _is_num(v), where,
                  "number or null (null = no samples, never fake zero)")
@@ -84,6 +90,8 @@ def check(doc: dict, expect_wedged: bool) -> list:
         need(steady, "pods_bound",
              lambda v: _is_num(v) and v > 0, "detail.steady_state",
              "positive (a clean soak must bind pods)")
+        need(detail, "unschedulable_reasons", _reasons_ok, "detail",
+             "predicate -> count object scraped off the reasons counter")
     return errs
 
 
@@ -100,7 +108,7 @@ def check_bundle(path: str, expect_timeout_span: bool = False) -> list:
         errs.append(f"{where}.kind: not a flight-recorder bundle")
     if not doc.get("reason"):
         errs.append(f"{where}.reason: missing")
-    for key in ("spans", "audit", "events", "notes"):
+    for key in ("spans", "audit", "events", "notes", "decisions"):
         if not isinstance(doc.get(key), list):
             errs.append(f"{where}.{key}: missing list")
     if not isinstance(doc.get("metrics"), dict) or \

@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Explainability smoke (tools/verify.sh): schedule a mixed
+feasible/infeasible batch through the LIVE kernel scheduler and prove the
+decision ledger's four surfaces agree.
+
+Asserts, from the exported surfaces only:
+
+1. every feasible pod binds via the kernel path and its ledger record
+   (served over HTTP at /explainz) names the node it actually landed on;
+2. the seeded-unschedulable pod gets a reference-style breakdown
+   ("0/N nodes are available: ...") that is IDENTICAL across the
+   Unschedulable condition, the FailedScheduling event, and /explainz;
+3. scheduler_unschedulable_reasons_total{predicate} is live on /metrics.
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _get_json(port: int, path: str) -> dict:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import RESTClient
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    from kubernetes_tpu.utils.debugserver import DebugServer
+
+    server = APIServer().start()
+    factory = sched = debug = None
+    try:
+        client = RESTClient.for_server(server, user_agent="explain-smoke")
+        for i in range(3):
+            client.create("nodes", api.Node(
+                metadata=api.ObjectMeta(
+                    name=f"n{i}",
+                    labels={api.LABEL_HOSTNAME: f"n{i}", "disk": "ssd"}),
+                status=api.NodeStatus(
+                    allocatable={"cpu": "4", "memory": "8Gi", "pods": "110"},
+                    conditions=[api.NodeCondition(type="Ready",
+                                                  status="True")])))
+
+        def pod(name, cpu="100m", selector=None):
+            return api.Pod(
+                metadata=api.ObjectMeta(name=name, namespace="default"),
+                spec=api.PodSpec(
+                    node_selector=selector,
+                    containers=[api.Container(
+                        name="c", image="pause",
+                        resources=api.ResourceRequirements(
+                            requests={"cpu": cpu, "memory": "100Mi"}))]))
+
+        for i in range(4):
+            client.create("pods", pod(f"fits-{i}"))
+        client.create("pods", pod("nofit", selector={"disk": "nvme"}))
+
+        factory = ConfigFactory(client)
+        factory.run(timeout=60)
+        sched = factory.create_batch_from_provider(batch_size=32).run()
+        debug = DebugServer(port=0, healthz=sched.healthy).start()
+
+        # wait: 4 binds + an Unschedulable condition on the seeded pod
+        deadline = time.monotonic() + 60
+        bound, cond = [], None
+        while time.monotonic() < deadline:
+            pods, _ = client.list("pods", "default")
+            bound = [p for p in pods if p.spec and p.spec.node_name]
+            nofit = next(p for p in pods if p.metadata.name == "nofit")
+            cond = next((c for c in ((nofit.status.conditions or [])
+                                     if nofit.status else [])
+                         if c.type == api.POD_SCHEDULED
+                         and c.status == api.CONDITION_FALSE), None)
+            if len(bound) >= 4 and cond is not None:
+                break
+            time.sleep(0.05)
+        if len(bound) < 4 or cond is None:
+            print(f"explain_smoke: bound={len(bound)}/4 cond={cond}",
+                  file=sys.stderr)
+            return 1
+        if sched.kernel_failures:
+            print(f"explain_smoke: kernel fell back ({sched.health}: "
+                  f"{sched.disabled_reason})", file=sys.stderr)
+            return 1
+
+        want = cond.message or ""
+        if not want.startswith("0/3 nodes are available:") \
+                or "MatchNodeSelector" not in want:
+            print(f"explain_smoke: condition message not a breakdown: "
+                  f"{want!r}", file=sys.stderr)
+            return 1
+
+        # surface 2: the FailedScheduling event carries the same breakdown
+        # (the recorder posts async — poll, don't sample)
+        deadline = time.monotonic() + 30
+        failed = []
+        while time.monotonic() < deadline:
+            evs, _ = client.list(
+                "events", "default",
+                field_selector="involvedObject.kind=Pod,"
+                               "involvedObject.name=nofit")
+            failed = [e for e in evs if e.reason == "FailedScheduling"]
+            if any(e.message == want for e in failed):
+                break
+            time.sleep(0.05)
+        if not any(e.message == want for e in failed):
+            print(f"explain_smoke: FailedScheduling event mismatch: "
+                  f"{[e.message for e in failed]!r} != {want!r}",
+                  file=sys.stderr)
+            return 1
+
+        # surface 3: /explainz over live HTTP
+        z = _get_json(debug.port, "/explainz?pod=default/nofit")
+        dec = z.get("decision") or {}
+        if dec.get("reason") != want:
+            print(f"explain_smoke: /explainz reason mismatch: "
+                  f"{dec.get('reason')!r} != {want!r}", file=sys.stderr)
+            return 1
+        for p in bound:
+            z = _get_json(debug.port,
+                          f"/explainz?pod=default/{p.metadata.name}")
+            node = (z.get("decision") or {}).get("node")
+            if node != p.spec.node_name:
+                print(f"explain_smoke: ledger says {p.metadata.name} -> "
+                      f"{node}, bound to {p.spec.node_name}", file=sys.stderr)
+                return 1
+
+        # surface 4: the reasons counter is scraped off /metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{debug.port}/metrics", timeout=5) as resp:
+            metrics = resp.read().decode()
+        if ('scheduler_unschedulable_reasons_total{'
+                'predicate="MatchNodeSelector"}') not in metrics:
+            print("explain_smoke: reasons counter missing from /metrics",
+                  file=sys.stderr)
+            return 1
+
+        print(f"explain_smoke: OK — 4 bound with ledger records, "
+              f"breakdown agrees across condition/event/explainz: {want!r}")
+        return 0
+    finally:
+        if debug is not None:
+            debug.stop()
+        if sched is not None:
+            sched.stop()
+        if factory is not None:
+            factory.stop()
+        server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,13 @@
 #                         flight-recorder bundle; check_soak.py
 #                         --expect-wedged schema-checks both
 #
-# Usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only]
+#   6. explain smoke    — tools/explain_smoke.py schedules a mixed
+#                         feasible/infeasible batch through the live kernel
+#                         scheduler and asserts the per-predicate breakdown
+#                         agrees across the Unschedulable condition, the
+#                         FailedScheduling event, /explainz, and /metrics
+#
+# Usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only|--explain-only]
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -32,13 +38,15 @@ run_static=1
 run_tests=1
 run_soak=1
 run_trace=1
+run_explain=1
 case "${1:-}" in
-  --static-only) run_tests=0; run_soak=0; run_trace=0 ;;
-  --tests-only)  run_static=0; run_soak=0; run_trace=0 ;;
-  --soak-only)   run_static=0; run_tests=0; run_trace=0 ;;
-  --trace-only)  run_static=0; run_tests=0; run_soak=0 ;;
+  --static-only)  run_tests=0; run_soak=0; run_trace=0; run_explain=0 ;;
+  --tests-only)   run_static=0; run_soak=0; run_trace=0; run_explain=0 ;;
+  --soak-only)    run_static=0; run_tests=0; run_trace=0; run_explain=0 ;;
+  --trace-only)   run_static=0; run_tests=0; run_soak=0; run_explain=0 ;;
+  --explain-only) run_static=0; run_tests=0; run_soak=0; run_trace=0 ;;
   "") ;;
-  *) echo "usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only]" >&2; exit 2 ;;
+  *) echo "usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only|--explain-only]" >&2; exit 2 ;;
 esac
 
 if [ "$run_static" = 1 ]; then
@@ -76,6 +84,11 @@ fi
 if [ "$run_trace" = 1 ]; then
   echo "== trace propagation smoke (client span <-> apiserver audit) =="
   JAX_PLATFORMS=cpu timeout -k 10 120 python tools/trace_smoke.py
+fi
+
+if [ "$run_explain" = 1 ]; then
+  echo "== explain smoke (decision ledger: condition == event == /explainz) =="
+  JAX_PLATFORMS=cpu timeout -k 10 180 python tools/explain_smoke.py
 fi
 
 echo "verify: OK"
