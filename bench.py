@@ -551,6 +551,28 @@ def run_e2e(n_nodes: int, n_pods: int) -> dict:
         server.stop()
 
 
+def _interleaved_medians(jax_mod, arms, runs: int) -> dict:
+    """The shared overhead-gate timing protocol: per round, perturb each
+    arm's used0 (distinct inputs defeat dispatch caching) and solve the
+    arms back-to-back so they share thermal/scheduler drift; returns
+    {label: median seconds}. Both explain_overhead and objective_overhead
+    gate on this — a protocol change must apply to both."""
+    import statistics
+
+    import numpy as np
+
+    times = {label: [] for label, _arrays, _solve in arms}
+    for k in range(1, runs + 1):
+        for label, arrays, solve_fn in arms:
+            a = dict(arrays)
+            a["used0"] = arrays["used0"].at[0, 0].add(np.float32(k) * 1e-3)
+            jax_mod.block_until_ready(a["used0"])
+            t0 = time.perf_counter()
+            solve_fn(a)
+            times[label].append(time.perf_counter() - t0)
+    return {label: statistics.median(ts) for label, ts in times.items()}
+
+
 def measure_explain_overhead(jax_mod) -> dict:
     """Device-cost gate for the explain feature (ISSUE 12): at the smoke
     shape (the full-carry-surface fixture batch), solve time with explain
@@ -582,19 +604,11 @@ def measure_explain_overhead(jax_mod) -> dict:
         return {"error": "explain=on changed assignments at the smoke shape",
                 "exceeded": True}
 
-    times = {False: [], True: []}
-    for k in range(1, runs + 1):
-        a = dict(arrays)
-        a["used0"] = arrays["used0"].at[0, 0].add(np.float32(k) * 1e-3)
-        jax_mod.block_until_ready(a["used0"])
-        for explain in (False, True):   # interleaved: shared thermal drift
-            t0 = time.perf_counter()
-            solve(a, explain)
-            times[explain].append(time.perf_counter() - t0)
-
-    import statistics
-    base_med = statistics.median(times[False])
-    exp_med = statistics.median(times[True])
+    meds = _interleaved_medians(jax_mod, [
+        ("base", arrays, lambda a: solve(a, False)),
+        ("explain", arrays, lambda a: solve(a, True)),
+    ], runs)
+    base_med, exp_med = meds["base"], meds["explain"]
     rel = (exp_med / base_med - 1.0) if base_med > 0 else 0.0
     return {
         "runs": runs,
@@ -602,6 +616,128 @@ def measure_explain_overhead(jax_mod) -> dict:
         "explain_seconds": round(exp_med, 5),
         "relative": round(rel, 4),
         "exceeded": bool(rel > 0.02 and (exp_med - base_med) > 0.005),
+    }
+
+
+def measure_objective_overhead(jax_mod, objective_name: str) -> dict:
+    """Device-cost gate for the scheduling-objective modes (ISSUE 13), the
+    explain_overhead pattern: at the smoke shape, interleaved perturbed
+    dispatches of the default program vs the named objective's program,
+    medians compared.  Objective modes ADD traced work (binpack one score
+    term, preempt/gang whole carries), so the guard is a runaway-regression
+    bound, not a parity bound: exceeded = >25% relative AND >25 ms absolute.
+
+    Also asserts the tentpole's no-cost-when-off contract on real dispatch
+    inputs: a disabled ObjectiveConfig lowers to the IDENTICAL program as
+    objective=None (same HLO text) and returns bit-identical assignments."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.ops.kernel import Weights, _schedule_jit, features_of
+    from kubernetes_tpu.ops.tensorize import Tensorizer
+    from kubernetes_tpu.scheduler.batch import make_plugin_args
+    from kubernetes_tpu.scheduler.objectives.config import (
+        DEFAULT_OBJECTIVE, GANG_LABEL, PRIORITY_ANNOTATION, gang_order,
+        get_objective,
+    )
+
+    objective = get_objective(objective_name)
+    runs = max(3, int(os.environ.get("BENCH_OBJECTIVE_RUNS", 15)))
+
+    nodes = []
+    for i in range(128):
+        nodes.append(api.Node(
+            metadata=api.ObjectMeta(
+                name=f"n{i:03d}",
+                labels={api.LABEL_HOSTNAME: f"n{i:03d}",
+                        api.LABEL_ZONE: f"z{i % 8}"}),
+            status=api.NodeStatus(
+                allocatable={"cpu": "4", "memory": "16Gi", "pods": "32"},
+                conditions=[api.NodeCondition(type="Ready",
+                                              status="True")])))
+
+    def mk_pod(name, cpu, labels=None, ann=None, node=""):
+        return api.Pod(
+            metadata=api.ObjectMeta(name=name, namespace="default",
+                                    labels=labels, annotations=ann),
+            spec=api.PodSpec(
+                node_name=node,
+                containers=[api.Container(
+                    name="c", image="pause",
+                    resources=api.ResourceRequirements(
+                        requests={"cpu": cpu, "memory": "256Mi"}))]))
+
+    existing = [mk_pod(f"e{i:03d}", "500m", node=f"n{i % 128:03d}",
+                       ann={PRIORITY_ANNOTATION: str(i % 3)})
+                for i in range(96)]
+    pending = []
+    for i in range(64):
+        labels, ann = {}, None
+        if i % 4 == 0:
+            labels[GANG_LABEL] = f"g{i // 16}"
+        elif i % 8 == 1:
+            ann = {PRIORITY_ANNOTATION: "5"}
+        pending.append(mk_pod(f"p{i:03d}", "200m", labels=labels, ann=ann))
+
+    args = make_plugin_args(nodes)
+    w = Weights()
+
+    def build(obj, pods):
+        ct = Tensorizer(plugin_args=args, objective=obj).build(
+            nodes, existing, pods)
+        arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
+        jax_mod.block_until_ready(arrays)
+        return ct, arrays
+
+    ct0, base_arrays = build(None, pending)
+    feats = features_of(ct0)
+
+    # the no-cost-when-off contract, on real inputs: disabled config ==
+    # objective-free trace, program text and assignments both
+    low_none = _schedule_jit.lower(base_arrays, ct0.n_zones, w, feats,
+                                   False, None).as_text()
+    low_off = _schedule_jit.lower(base_arrays, ct0.n_zones, w, feats,
+                                  False, DEFAULT_OBJECTIVE).as_text()
+    if low_none != low_off:
+        return {"error": "disabled objective changed the traced program",
+                "exceeded": True}
+    out_none = np.asarray(_schedule_jit(base_arrays, ct0.n_zones, w, feats))
+    out_off = np.asarray(_schedule_jit(base_arrays, ct0.n_zones, w, feats,
+                                       False, DEFAULT_OBJECTIVE))
+    if not np.array_equal(out_none, out_off):
+        return {"error": "disabled objective changed assignments",
+                "exceeded": True}
+    if objective is None or not objective.enabled:
+        return {"objective": "default", "identical": True, "exceeded": False}
+
+    obj_pending = pending
+    if objective.gang:
+        obj_pending, _ = gang_order(pending)
+    cto, obj_arrays = build(objective, obj_pending)
+    featso = features_of(cto)
+
+    def solve(a, ct, feats_, obj):
+        out = _schedule_jit(a, ct.n_zones, w, feats_, False, obj)
+        return jax_mod.tree_util.tree_map(np.asarray, out)
+
+    solve(base_arrays, ct0, feats, None)        # warm both compiles
+    solve(obj_arrays, cto, featso, objective)
+
+    meds = _interleaved_medians(jax_mod, [
+        ("base", base_arrays, lambda a: solve(a, ct0, feats, None)),
+        ("obj", obj_arrays, lambda a: solve(a, cto, featso, objective)),
+    ], runs)
+    base_med, obj_med = meds["base"], meds["obj"]
+    rel = (obj_med / base_med - 1.0) if base_med > 0 else 0.0
+    return {
+        "objective": objective.name,
+        "runs": runs,
+        "base_seconds": round(base_med, 5),
+        "objective_seconds": round(obj_med, 5),
+        "relative": round(rel, 4),
+        "identical": True,
+        "exceeded": bool(rel > 0.25 and (obj_med - base_med) > 0.025),
     }
 
 
@@ -809,6 +945,19 @@ def main() -> int:
             # (the error key is checked alongside `exceeded` below)
             explain_overhead = {"error": repr(e)}
 
+    objective_overhead = None
+    if os.environ.get("BENCH_OBJECTIVE_GATE", "1") != "0":
+        # always runs the disabled-config bit-identity assert; with
+        # --objective <mode> additionally medians that mode's program
+        # against the default one (interleaved, same smoke shape)
+        obj_name = os.environ.get("BENCH_OBJECTIVE", "default")
+        try:
+            objective_overhead = run_with_timeout(
+                lambda: measure_objective_overhead(jax, obj_name), 600,
+                "objective overhead")
+        except Exception as e:
+            objective_overhead = {"error": repr(e)}
+
     # correctness guard: no node overcommitted on cpu or pod slots
     # (existing bound pods count toward both caps — 100m each)
     assign = res[res >= 0]
@@ -851,6 +1000,8 @@ def main() -> int:
         result["detail"]["restart"] = restart
     if explain_overhead is not None:
         result["detail"]["explain_overhead"] = explain_overhead
+    if objective_overhead is not None:
+        result["detail"]["objective_overhead"] = objective_overhead
     if suspect:
         result["detail"]["estimator_notes"] = suspect
     if backend_err is not None:
@@ -877,6 +1028,10 @@ def main() -> int:
     if explain_overhead is not None and (explain_overhead.get("exceeded")
                                          or explain_overhead.get("error")):
         return 1  # explain must stay within 2% — and must be measurable
+    if objective_overhead is not None and (
+            objective_overhead.get("exceeded")
+            or objective_overhead.get("error")):
+        return 1  # objective modes: bounded overhead + exact off-identity
     return 1 if timeouts else 0
 
 
@@ -897,6 +1052,10 @@ def main_soak() -> int:
         scrape_period=float(os.environ.get("SOAK_SCRAPE_PERIOD", 2)),
         batch_size=int(os.environ.get("SOAK_BATCH", 256)),
         hang_stage=os.environ.get("BENCH_SOAK_HANG_STAGE", ""),
+        scenario=os.environ.get("SOAK_SCENARIO", "churn"),
+        gang_size=int(os.environ.get("SOAK_GANG_SIZE", 3)),
+        preempt_every=int(os.environ.get("SOAK_PREEMPT_EVERY", 8)),
+        objective=os.environ.get("SOAK_OBJECTIVE", ""),
     )
     report = run_soak(cfg)
     steady = report.get("steady_state") or {}
@@ -927,7 +1086,21 @@ def parse_mode(argv) -> str:
     p = argparse.ArgumentParser(prog="bench.py")
     p.add_argument("--mode", choices=("batch", "soak"),
                    default=os.environ.get("BENCH_MODE", "batch"))
-    return p.parse_args(argv).mode
+    p.add_argument(
+        "--objective",
+        choices=("default", "binpack", "preempt", "gang", "gang_preempt"),
+        default=os.environ.get("BENCH_OBJECTIVE", "default"),
+        help="scheduling-objective config for the overhead gate (batch "
+             "mode: detail.objective_overhead) or the soak's scheduler "
+             "(soak mode)")
+    args = p.parse_args(argv)
+    # downstream code reads these through the env (the soak subprocess and
+    # the gate helper both live behind run_with_timeout seams)
+    os.environ["BENCH_OBJECTIVE"] = args.objective
+    if args.mode == "soak" and args.objective != "default" \
+            and not os.environ.get("SOAK_OBJECTIVE"):
+        os.environ["SOAK_OBJECTIVE"] = args.objective
+    return args.mode
 
 
 if __name__ == "__main__":

@@ -164,6 +164,28 @@ class FIFO:
                 out.append(obj)
         return out
 
+    def drain_where(self, pred: Callable) -> list:
+        """Pop every queued item matching pred without blocking (gang-aware
+        intake: a count-based drain must not strand the tail of a gang in
+        the queue)."""
+        with self._lock:
+            keys = [k for k, v in self._items.items() if pred(v)]
+            return [self._items.pop(k) for k in keys]
+
+    def requeue_front(self, obj):
+        """Put a drained item back at the HEAD of the queue (give-back
+        intake: returned work must not go to the tail behind younger
+        arrivals, or it starves under sustained load). If a newer copy was
+        queued meanwhile it wins — only its position moves."""
+        key = self._key(obj)
+        with self._lock:
+            fresh = key not in self._items
+            if fresh:
+                self._items[key] = obj
+            self._items.move_to_end(key, last=False)
+            if fresh:
+                self._lock.notify()
+
     def close(self):
         with self._lock:
             self._closed = True

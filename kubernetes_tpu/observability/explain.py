@@ -65,15 +65,33 @@ PREDICATES: Tuple[Tuple[str, str], ...] = (
     ("MatchInterPodAffinity", "MatchInterPodAffinity"),
 )
 PREDICATE_KEYS = tuple(k for k, _ in PREDICATES)
-_REASON_TEXT = dict(PREDICATES)
+# gang mode appends one elimination row after the canonical 13: nodes a
+# gang member loses to the topology-domain restriction (no topology label,
+# wrong domain, or the whole gang already failed) — ops/kernel.py's
+# "gang topology" row
+GANG_PREDICATE = ("GangTopology", "NoMatchingGangDomain")
+_REASON_TEXT = dict(PREDICATES + (GANG_PREDICATE,))
 N_STATIC_ROWS = 5  # selector..host come from static_pass; the rest from scan
 
+
+def predicate_keys_for(n_rows: int) -> Tuple[str, ...]:
+    """Row keys for a survivor tuple: the canonical 13, plus the gang row
+    when the solve traced one (len tells which — the axis is static per
+    objective config)."""
+    keys = PREDICATE_KEYS
+    if n_rows > len(keys):
+        keys = keys + (GANG_PREDICATE[0],)
+    return keys[:n_rows]
+
+
 # Canonical score component order (scheduler/priorities.py names); decode
-# and oracle both emit every component whose weight is nonzero.
+# and oracle both emit every component whose weight is nonzero.  Objective
+# modes may append non-canonical components ("binpack") after these.
 COMPONENTS: Tuple[str, ...] = (
     "least_requested", "balanced", "spread", "node_affinity",
     "taint_toleration", "interpod_affinity", "image_locality", "equal",
 )
+COMPONENT_ORDER: Tuple[str, ...] = COMPONENTS + ("binpack",)
 
 REASONS_COUNTER = "scheduler_unschedulable_reasons_total"
 
@@ -92,6 +110,11 @@ class DecisionRecord:
     runner_up_score: Optional[float] = None
     runner_up_components: Dict[str, float] = field(default_factory=dict)
     ts: str = ""
+    # objective verdicts (scheduler/objectives/decode.annotate_records):
+    # preemption = {"node": nominated, "victims": [...]} on a preemptor;
+    # gang = {"name": ..., "outcome": "placed"|"rejected"} on a gang member
+    preemption: Optional[dict] = None
+    gang: Optional[dict] = None
 
     @property
     def feasible(self) -> int:
@@ -102,7 +125,8 @@ class DecisionRecord:
         canonical order, zero rows omitted."""
         out: "OrderedDict[str, int]" = OrderedDict()
         prev = self.nodes_total
-        for key, surv in zip(PREDICATE_KEYS, self.survivors):
+        for key, surv in zip(predicate_keys_for(len(self.survivors)),
+                             self.survivors):
             gone = prev - surv
             if gone > 0:
                 out[key] = gone
@@ -117,6 +141,10 @@ class DecisionRecord:
             "eliminations": dict(self.eliminations()),
             "ts": self.ts,
         }
+        if self.preemption is not None:
+            d["preemption"] = dict(self.preemption)
+        if self.gang is not None:
+            d["gang"] = dict(self.gang)
         if self.node is None:
             d["reason"] = format_reason(self)
         else:
@@ -134,7 +162,15 @@ def format_reason(rec: DecisionRecord) -> str:
     """The reference-style unschedulable breakdown: '0/N nodes are
     available: <count> <reason>, ...' — counts descending, names as
     tie-break, trailing period included (generic_scheduler.go:40-67
-    flavor)."""
+    flavor).  A preemptor's record formats as its nomination instead (the
+    same string the FailedScheduling event carries), so every surface
+    agrees in preempt mode too."""
+    if rec.preemption is not None:
+        from kubernetes_tpu.scheduler.objectives.decode import (
+            preemption_message,
+        )
+        return preemption_message(rec.preemption["node"],
+                                  rec.preemption["victims"])
     elim = rec.eliminations()
     if not elim:
         return (f"0/{rec.nodes_total} nodes are available: "
@@ -178,7 +214,8 @@ class KernelFitError(FitError):
 
 # --- kernel output decode -----------------------------------------------------
 
-def decode_batch(ct, out, extras, weights, feats) -> List[DecisionRecord]:
+def decode_batch(ct, out, extras, weights, feats,
+                 objective=None) -> List[DecisionRecord]:
     """Host decode of the kernel's explain extras into DecisionRecords.
 
     `out` is the [P] assignment vector, `extras` the dict _schedule_jit
@@ -186,11 +223,16 @@ def decode_batch(ct, out, extras, weights, feats) -> List[DecisionRecord]:
     the kernel omits as argmax-neutral are added back here so totals equal
     the priorities.py replay: taint_toleration contributes a flat
     10*weight when no PreferNoSchedule taint is traced, equal a flat
-    weight*1 (already inside the kernel total when its weight is nonzero)."""
+    weight*1 (already inside the kernel total when its weight is nonzero).
+
+    With an enabled objective config, the emitted component list may carry
+    "binpack" and the dynamic survivor block one extra gang-topology row —
+    both decoded here; the objective verdicts themselves (victim sets, gang
+    outcomes) are stamped afterwards by objectives.decode.annotate_records."""
     from kubernetes_tpu.ops.kernel import explain_component_names
 
     wd = dict(weights.__dict__)
-    emitted = explain_component_names(feats, weights)
+    emitted = explain_component_names(feats, weights, objective)
     ts = _now_iso()
     NEG_HALF = -5e8  # anything below: the NEG sentinel, not a score
 
@@ -219,7 +261,7 @@ def decode_batch(ct, out, extras, weights, feats) -> List[DecisionRecord]:
                 comp[name] = float(wmap["equal"])  # already in kernel total
             else:
                 comp[name] = 0.0  # oracle value when the feature is absent
-        return {name: comp[name] for name in COMPONENTS if name in comp}
+        return {name: comp[name] for name in COMPONENT_ORDER if name in comp}
 
     # the kernel's survivor chain starts from node_valid — in the
     # incremental mirror n_real_nodes is the slot high-water mark and can
@@ -342,7 +384,7 @@ def note_unschedulable(err: Exception) -> None:
 # --- the Python replay (the oracle-equivalence anchor) ------------------------
 
 def oracle_breakdown(nodes, existing, pending, args, assignments,
-                     weights=None) -> List[DecisionRecord]:
+                     weights=None, objective=None) -> List[DecisionRecord]:
     """Node-by-node replay of scheduler/predicates.py + priorities.py over
     the canonical rows, with the kernel's sequential-commit semantics (each
     pod's decision sees every prior in-batch commit from `assignments`).
@@ -350,7 +392,21 @@ def oracle_breakdown(nodes, existing, pending, args, assignments,
     This is the ground truth the kernel's explain output must match exactly
     (the ISSUE-12 acceptance anchor): cumulative survivor counts per
     predicate row, and — for placed pods — the winner/runner-up weighted
-    score decomposition."""
+    score decomposition.
+
+    With an enabled objective config the replay delegates to the objective
+    oracle (scheduler/objectives/oracle.py), which derives its OWN
+    placements/victims/gang verdicts node-by-node — `assignments` is
+    ignored there; the oracle-equivalence tests pin the kernel's outputs
+    equal to the replay's, not the other way around.  `pending` must
+    already be in gang order (objectives.gang_order) in gang mode, exactly
+    as the kernel solves it."""
+    if objective is not None and getattr(objective, "enabled", False):
+        from kubernetes_tpu.scheduler.objectives.oracle import (
+            oracle_objective,
+        )
+        return oracle_objective(nodes, existing, pending, args, objective,
+                                weights=weights).records
     from kubernetes_tpu.api.serialization import deep_copy
     from kubernetes_tpu.ops.kernel import Weights
     from kubernetes_tpu.scheduler import predicates as preds

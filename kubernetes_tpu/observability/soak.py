@@ -39,6 +39,8 @@ E2E_HIST = "scheduler_e2e_scheduling_latency_seconds"
 QUEUE_HIST = "scheduler_pod_queue_wait_seconds"
 TIMEOUT_COUNTER = "scheduler_stage_timeout_total"
 REASONS_COUNTER = "scheduler_unschedulable_reasons_total"
+PREEMPT_COUNTER = "scheduler_preemptions_total"
+GANG_COUNTER = "scheduler_gang_placements_total"
 
 SOAK_PHASES = ("boot", "churn", "drain", "report")
 
@@ -54,6 +56,14 @@ class SoakConfig:
     batch_size: int = 256
     heartbeat_period: float = 10.0
     drain_timeout: float = 30.0       # wait for stragglers after churn
+    # scenario: "churn" (singleton pods) or "gang_churn" — gangs of
+    # `gang_size` pods arriving/departing as units under the gang_preempt
+    # objective, with an occasional whole-node high-priority pod applying
+    # preemption pressure (every `preempt_every`-th creation burst)
+    scenario: str = "churn"
+    gang_size: int = 3
+    preempt_every: int = 8
+    objective: str = ""               # "" = scenario default
     # SLO objectives (specs built in default_slos; override via `slos`)
     slo_pods_per_sec: float = 0.0     # 0 = half the create rate
     slo_e2e_p99_seconds: float = 4.0
@@ -69,6 +79,12 @@ class SoakConfig:
 
     def in_flight_cap(self) -> int:
         return self.max_in_flight or max(int(self.create_rate * 2), 50)
+
+    def effective_objective(self) -> str:
+        """The scheduling objective the soak's scheduler runs under."""
+        if self.objective:
+            return self.objective
+        return "gang_preempt" if self.scenario == "gang_churn" else ""
 
     def deadlines(self) -> Dict[str, float]:
         d = {"boot": 120.0,
@@ -111,31 +127,45 @@ def _e2e_count(rnd) -> float:
 def _reasons_of(rnd) -> Dict[str, float]:
     """Absolute scheduler_unschedulable_reasons_total values by predicate
     in a scraped round."""
-    fam = rnd.families.get(REASONS_COUNTER) if rnd is not None else None
-    return ({dict(lk).get("predicate", "?"): v
-             for lk, v in fam.samples.items()} if fam else {})
+    return _counter_abs(rnd, REASONS_COUNTER, "predicate")
 
 
 def _reasons_delta(rnd, base: Dict[str, float]) -> Dict[str, float]:
     """Per-predicate unschedulable-reason movement vs the boot baseline —
     reasons from before this soak are not this soak's reasons."""
-    out = {}
-    for pred, v in _reasons_of(rnd).items():
-        delta = v - base.get(pred, 0.0)
-        if delta > 0:
-            out[pred] = delta
-    return out
+    return _counter_delta(rnd, base, REASONS_COUNTER, "predicate")
 
 
-def _mk_pod(i: int):
+def _mk_pod(i: int, labels=None, annotations=None, cpu="100m"):
     from kubernetes_tpu.api import types as api
+    lbls = {"app": "soak"}
+    lbls.update(labels or {})
     return api.Pod(
         metadata=api.ObjectMeta(name=f"soak-{i:07d}", namespace="default",
-                                labels={"app": "soak"}),
+                                labels=lbls, annotations=annotations),
         spec=api.PodSpec(containers=[api.Container(
             name="c", image="pause",
             resources=api.ResourceRequirements(
-                requests={"cpu": "100m", "memory": "100Mi"}))]))
+                requests={"cpu": cpu, "memory": "100Mi"}))]))
+
+
+def _counter_delta(rnd, base: Dict[str, float], metric: str,
+                   label: str) -> Dict[str, float]:
+    """Per-label-value movement of a counter family vs an absolute
+    baseline snapshot — counts from before this soak are not this
+    soak's counts."""
+    out = {}
+    for k, v in _counter_abs(rnd, metric, label).items():
+        delta = v - base.get(k, 0.0)
+        if delta > 0:
+            out[k] = delta
+    return out
+
+
+def _counter_abs(rnd, metric: str, label: str) -> Dict[str, float]:
+    fam = rnd.families.get(metric) if rnd is not None else None
+    return ({dict(lk).get(label, "?"): v for lk, v in fam.samples.items()}
+            if fam else {})
 
 
 class _Churner:
@@ -181,6 +211,80 @@ class _Churner:
                 self.deleted += 1  # already gone: deletion still happened
 
 
+class _GangChurner(_Churner):
+    """gang_churn driver: pods arrive as whole gangs of `gang_size` (one
+    gang label per burst, so the scheduler must co-place them on one
+    topology domain), departures delete whole gangs oldest-first, and every
+    `preempt_every`-th burst is ONE whole-node high-priority pod instead —
+    sustained preemption pressure once the cluster fills."""
+
+    def __init__(self, client, rate: float, cap: int, gang_size: int,
+                 preempt_every: int, node_cpu_m: int = 4000):
+        super().__init__(client, rate, cap)
+        self.gang_size = max(gang_size, 1)
+        self.preempt_every = max(preempt_every, 2)
+        self.node_cpu_m = node_cpu_m
+        self._bursts = 0
+        # name allocator: advances per name handed out, NOT per successful
+        # create — a mid-burst create failure must not make the next burst
+        # reuse a name that already exists (AlreadyExists would leave that
+        # gang permanently short a member)
+        self._name_seq = 0
+        # arrival bursts, oldest first — departures remove whole units so
+        # the cap trim never leaves a partially-departed gang running
+        self._groups: list = []
+
+    def tick(self, now: float) -> None:
+        from kubernetes_tpu.scheduler.objectives.config import (
+            GANG_LABEL, PRIORITY_ANNOTATION,
+        )
+        if self._last is None:
+            self._last = now
+            return
+        self._debt += (now - self._last) * self.rate
+        self._last = now
+        while self._debt >= self.gang_size:
+            self._debt -= self.gang_size
+            self._bursts += 1
+            if self._bursts % self.preempt_every == 0:
+                # a near-whole-node high-priority pod: schedulable only by
+                # evicting lower-priority gang members once nodes fill
+                members = [_mk_pod(
+                    self._name_seq,
+                    annotations={PRIORITY_ANNOTATION: "10"},
+                    cpu=f"{self.node_cpu_m - 200}m")]
+            else:
+                gang = f"gang-{self._bursts:06d}"
+                members = [_mk_pod(self._name_seq + j,
+                                   labels={GANG_LABEL: gang})
+                           for j in range(self.gang_size)]
+            self._name_seq += len(members)
+            burst = []
+            for p in members:
+                try:
+                    self.client.create("pods", p)
+                    burst.append(p.metadata.name)
+                    self._live.append(p.metadata.name)
+                    self.created += 1
+                except Exception as e:
+                    self.create_errors += 1
+                    log.warning("soak create failed: %s", e)
+            if burst:
+                self._groups.append(burst)
+        # whole units oldest-first: a pod-at-a-time trim goes out of gang
+        # alignment at the first 1-pod preempt burst and then splits every
+        # gang it touches, which is not the departure pattern this
+        # scenario claims to exercise
+        while len(self._live) > self.cap and self._groups:
+            for name in self._groups.pop(0):
+                self._live.pop(0)
+                try:
+                    self.client.delete("pods", name, "default")
+                    self.deleted += 1
+                except Exception:
+                    self.deleted += 1  # already gone (possibly preempted)
+
+
 def run_soak(cfg: SoakConfig, scraper: Optional[Scraper] = None) -> dict:
     """Run the churn soak; returns the report dict bench.py --mode soak
     emits. Never hangs: each phase runs under a watchdog deadline and a
@@ -190,7 +294,9 @@ def run_soak(cfg: SoakConfig, scraper: Optional[Scraper] = None) -> dict:
         "config": {"nodes": cfg.num_nodes, "create_rate": cfg.create_rate,
                    "duration_seconds": cfg.duration_seconds,
                    "scrape_period": cfg.scrape_period,
-                   "in_flight_cap": cfg.in_flight_cap()},
+                   "in_flight_cap": cfg.in_flight_cap(),
+                   "scenario": cfg.scenario,
+                   "objective": cfg.effective_objective() or "default"},
         "rounds": [], "slos": [], "wedged": False,
     }
     state: dict = {}
@@ -283,7 +389,8 @@ def _boot(cfg: SoakConfig, state: dict, scraper: Optional[Scraper]) -> None:
     factory = state["factory"] = ConfigFactory(client)
     factory.run(timeout=60)
     sched = state["sched"] = factory.create_batch_from_provider(
-        batch_size=cfg.batch_size, stage_deadlines=cfg.stage_deadlines)
+        batch_size=cfg.batch_size, stage_deadlines=cfg.stage_deadlines,
+        objective=cfg.effective_objective() or None)
     if cfg.hang_stage:
         _seed_hang(sched, cfg.hang_stage)
     # the debug mux every component serves; the scraper reads THIS, not the
@@ -309,6 +416,8 @@ def _boot(cfg: SoakConfig, state: dict, scraper: Optional[Scraper]) -> None:
         {dict(lk).get("stage", "?"): v for lk, v in fam.samples.items()}
         if fam else {})
     state["reasons_base"] = _reasons_of(base)
+    state["preempt_base"] = _counter_abs(base, PREEMPT_COUNTER, "reason")
+    state["gang_base"] = _counter_abs(base, GANG_COUNTER, "outcome")
     state["e2e_base"] = _e2e_count(base)
     state["steady_base_count"] = state["e2e_base"]
     state["engine"] = SLOEngine(
@@ -332,8 +441,13 @@ def _seed_hang(sched, stage_name: str) -> None:
 
 
 def _churn(cfg: SoakConfig, state: dict, report: dict) -> None:
-    churner = state["churner"] = _Churner(
-        state["client"], cfg.create_rate, cfg.in_flight_cap())
+    if cfg.scenario == "gang_churn":
+        churner = state["churner"] = _GangChurner(
+            state["client"], cfg.create_rate, cfg.in_flight_cap(),
+            cfg.gang_size, cfg.preempt_every)
+    else:
+        churner = state["churner"] = _Churner(
+            state["client"], cfg.create_rate, cfg.in_flight_cap())
     scr: Scraper = state["scraper"]
     engine: SLOEngine = state["engine"]
     state["t0"] = time.monotonic()
@@ -374,6 +488,15 @@ def _record_round(cfg: SoakConfig, state: dict, report: dict,
         "slos": {r.name: r.verdict for r in engine.evaluate()},
     })
     rnd = report["rounds"][-1]
+    if cfg.scenario == "gang_churn":
+        last = scr.last_good("scheduler")
+        gangs = _counter_delta(last, state.get("gang_base", {}),
+                               GANG_COUNTER, "outcome")
+        rnd["preemptions"] = sum(_counter_delta(
+            last, state.get("preempt_base", {}),
+            PREEMPT_COUNTER, "reason").values())
+        rnd["gangs_placed"] = gangs.get("placed", 0.0)
+        rnd["gangs_rejected"] = gangs.get("rejected", 0.0)
     # black-box feed: every scraped round (and its counter movement) lands
     # in the flight recorder's notes ring, so a bundle dumped mid-wedge
     # shows the rounds leading INTO it, not just the final state
@@ -450,6 +573,15 @@ def _finalize(cfg: SoakConfig, state: dict, report: dict) -> None:
     # rely on the key
     out["unschedulable_reasons"] = _reasons_delta(
         last, state.get("reasons_base", {}))
+    if cfg.scenario == "gang_churn":
+        # the objective verdicts for the whole soak, scraped off the same
+        # counters the operator's dashboards read (baseline-rebased)
+        gangs = _counter_delta(last, state.get("gang_base", {}),
+                               GANG_COUNTER, "outcome")
+        out["preemptions"] = _counter_delta(
+            last, state.get("preempt_base", {}), PREEMPT_COUNTER, "reason")
+        out["gangs_placed"] = gangs.get("placed", 0.0)
+        out["gangs_rejected"] = gangs.get("rejected", 0.0)
     out["kernel"] = {
         "batches": sched.kernel_batches, "pods": sched.kernel_pods,
         "failures": sched.kernel_failures, "health": sched.health,

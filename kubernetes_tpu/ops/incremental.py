@@ -125,9 +125,17 @@ class IncrementalTensorizer:
     def __init__(self, plugin_args=None,
                  failure_domains=(api.LABEL_HOSTNAME, api.LABEL_ZONE,
                                   api.LABEL_REGION),
-                 node_cap: int = LANE, pod_bucket: Optional[int] = None):
+                 node_cap: int = LANE, pod_bucket: Optional[int] = None,
+                 objective=None):
         self.args = plugin_args
         self.failure_domains = tuple(failure_domains)
+        # enabled ObjectiveConfig -> the objective operand arrays ride every
+        # batch (scheduler/objectives/tensors.py); None/default -> the
+        # pre-objective tensor layout, bit for bit
+        from kubernetes_tpu.scheduler.objectives.config import (
+            resolve_objective,
+        )
+        self.objective = resolve_objective(objective)
         # fixed pod-axis pad (usually the scheduler's batch_size): every
         # full batch AND the tail then trace to one program shape, so the
         # whole drain costs a single XLA compile
@@ -1337,6 +1345,26 @@ class IncrementalTensorizer:
         from kubernetes_tpu.scheduler.predicates import (
             DEFAULT_MAX_EBS_VOLUMES, DEFAULT_MAX_GCE_PD_VOLUMES,
         )
+        objective_kw = {}
+        if self.objective is not None:
+            from kubernetes_tpu.scheduler.objectives.tensors import (
+                build_objective_tensors,
+            )
+            # victim candidates: the mirror's placed set minus terminating
+            # pods — the same exclusion the full Tensorizer applies.
+            # NOTE: unlike the node tensors the mirror keeps device-
+            # resident, the victim prefix tables are rebuilt host-side per
+            # batch (an O(placed·log) sort + [6, KV+1, N] upload) — at the
+            # 30k-pod target this belongs in the delta path; until then
+            # preempt mode pays it inside tensorize/upload (ROADMAP 3b)
+            placed_live = [(pod, slot) for key, (pod, slot)
+                           in self._placed.items()
+                           if key not in self._terminating]
+            arrays, info = build_objective_tensors(
+                self.objective, pending, Pp, N,
+                lambda slot: self._node_labels_d.get(slot, {}), placed_live)
+            objective_kw = dict(arrays)
+            objective_kw["objective_info"] = info
         return ClusterTensors(
             node_names=list(self._node_names),
             pod_keys=[_pod_key(p) for p in pending],
@@ -1380,6 +1408,7 @@ class IncrementalTensorizer:
             max_ebs=np.asarray(DEFAULT_MAX_EBS_VOLUMES, np.float32),
             max_gce=np.asarray(DEFAULT_MAX_GCE_PD_VOLUMES, np.float32),
             n_real_nodes=self._hi, n_real_pods=P,
+            **objective_kw,
         )
 
     # --- device residency -----------------------------------------------------
@@ -1483,6 +1512,8 @@ class IncrementalTensorizer:
         pending pod, FIFO order — drop-in for scheduler.batch.tpu_batch.
         With explain, returns (names, DecisionRecords) decoded from the
         kernel's per-predicate provenance (observability/explain.py).
+        With an enabled objective (ctor arg), the return additionally grows
+        an ObjectiveOutcome, exactly like kernel.schedule_batch.
 
         `stage(name, fn)` (ops/watchdog.run_stages hook) observes the
         pipeline as named stages: tensorize -> upload -> compile|solve.
@@ -1493,10 +1524,17 @@ class IncrementalTensorizer:
         which would deadlock the informer pipeline, a strictly worse wedge
         than the hang being converted."""
         from kubernetes_tpu.ops.kernel import (
-            Weights, assignments_to_names, dispatch, features_of,
+            Weights, decode_dispatch, dispatch, features_of,
         )
         weights = weights or Weights()
         run = stage or (lambda _n, fn: fn())
+        objective = self.objective
+        perm = None
+        if objective is not None and objective.gang:
+            # gang members must be contiguous in scan order; solve in the
+            # gang-grouped order and un-permute the results below
+            from kubernetes_tpu.scheduler.objectives.config import gang_order
+            pending, perm = gang_order(pending)
 
         def _tensorize():
             with self._lock:
@@ -1512,10 +1550,9 @@ class IncrementalTensorizer:
         arrays = run("upload", lambda: self._upload_staged(plan,
                                                            device=device))
         out = dispatch(arrays, n_zones, weights, feats, stage=stage,
-                       explain=explain)
-        if not explain:
-            return assignments_to_names(out, ct)
-        out, extras = out
-        names = assignments_to_names(out, ct)
-        from kubernetes_tpu.observability.explain import decode_batch
-        return names, decode_batch(ct, out, extras, weights, feats)
+                       explain=explain, objective=objective)
+        ret = decode_dispatch(ct, out, weights, feats, explain, objective)
+        if perm is None:
+            return ret
+        from kubernetes_tpu.ops.kernel import unpermute_result
+        return unpermute_result(ret, perm)

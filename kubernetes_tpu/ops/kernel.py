@@ -78,6 +78,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.ops.tensorize import ClusterTensors
+from kubernetes_tpu.scheduler.objectives.config import ObjectiveConfig
 
 # numpy scalar, not jnp: module import must stay device-free (backend init
 # at import time would grab the chip even for CPU-only test runs)
@@ -144,7 +145,8 @@ class Features(NamedTuple):
         return self.ports or self.disk or self.ebs or self.gce
 
 
-def explain_component_names(feats: Features, w: Weights) -> List[str]:
+def explain_component_names(feats: Features, w: Weights,
+                            obj: Optional[ObjectiveConfig] = None) -> List[str]:
     """Score components the kernel emits on-device when `explain` is on, in
     stack order. Must mirror the rows greedy_commit actually stacks: the
     host decode (observability/explain.py) zips this list against the
@@ -160,6 +162,8 @@ def explain_component_names(feats: Features, w: Weights) -> List[str]:
         names.append("interpod_affinity")
     if feats.image and w.image_locality != 0:
         names.append("image_locality")
+    if obj is not None and obj.binpack and obj.binpack_weight != 0:
+        names.append("binpack")
     return names
 
 
@@ -364,7 +368,8 @@ class _Layout:
 
 
 def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
-                  explain: bool = False):
+                  explain: bool = False,
+                  obj: Optional[ObjectiveConfig] = None):
     """lax.scan over pods; returns assignments [P] i32 (-1 = unschedulable).
 
     Exactly the reference's sequential semantics (scheduler.go:93-155 one
@@ -389,8 +394,32 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
     When explain is off this function traces the exact program it always
     has — the flag is a static jit key, so `off` is bit-identical to
     today's assignments, and `on` only ADDS reductions (the mask and score
-    math feeding the argmax is shared, also bit-identical)."""
+    math feeding the argmax is shared, also bit-identical).
+
+    With `obj` (an enabled ObjectiveConfig — also a static jit key, so the
+    default/None path is the untouched pre-objective program), the scan
+    additionally solves the scheduling-objective modes in-step:
+
+    - binpack: a MostRequested fragmentation score component;
+    - preempt: a pod with zero feasible nodes nominates victims as a masked
+      argmin over (victim priority, victim count, node order) against the
+      per-node sorted victim prefix tables (vict_prio/vict_cum), relieves
+      the victims' resource occupancy in-carry, and commits at the
+      nominated node; per-pod victim counts stream out as `pk`;
+    - gang: gang members (contiguous in pod order — objectives.gang_order)
+      are masked to nodes sharing one topology-label domain, commit deltas
+      accumulate in a per-open-gang carry, and a member with zero feasible
+      nodes rolls the whole gang's nstate deltas back inside the scan and
+      marks the gang failed (all-or-nothing — the host decode nullifies the
+      already-emitted member assignments). Port/affinity-hit shadows from
+      rolled-back members deliberately persist until the next batch
+      (conservative; state is rebuilt per batch), and gang members never
+      preempt — both mirrored exactly by the oracle replay."""
     assert not feats.hw or feats.req, "hw carry requires the req term table"
+    obj_on = obj is not None and obj.enabled
+    use_gang = obj_on and obj.gang
+    use_preempt = obj_on and obj.preempt
+    use_binpack = obj_on and obj.binpack and obj.binpack_weight != 0
     alloc = t["alloc"]                      # [N, 4]
     N = alloc.shape[0]
     G = t["group_counts0"].shape[1]
@@ -506,6 +535,16 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
         lay.add("te_match", T2)
         pieces.append(jnp.pad(t["te_match"].T,
                               ((0, 0), (0, T2 - t["te_match"].shape[0]))))
+    if use_preempt:
+        vict_cum = t["vict_cum"]       # [6, KV+1, N] prefix relief per node
+        vict_prio = t["vict_prio"]     # [KV, N] sorted victim priorities
+        KV = vict_prio.shape[0]
+        put("prio", t["pod_priority"][:, None])              # 1
+    if use_gang:
+        g_null = t["gang_dom0"].shape[0] - 1   # last gang slot = null
+        put("gangrow", jnp.stack([
+            t["pod_gang"].astype(jnp.float32),
+            (t["pod_gang"] < g_null).astype(jnp.float32)], axis=1))  # 2
     prow = jnp.concatenate(pieces, axis=1)                   # [P, W]
 
     xs = {"prow": prow, "mask": s["mask"]}
@@ -522,6 +561,13 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
     if use_ip:
         init["hits"] = hits0
         init["req_nomatch"] = req_nomatch0
+    if use_preempt:
+        init["evicted"] = jnp.zeros((N,), jnp.float32)
+    if use_gang:
+        init["gang_dom"] = t["gang_dom0"]          # [GG] i32, -1 = unchosen
+        init["gang_failed"] = t["gang_failed0"]    # [GG] f32 flags
+        init["gang_delta"] = jnp.zeros_like(nstate0)
+        init["cur_gang"] = jnp.int32(g_null)
 
     wf = {k: np.float32(v) for k, v in w.__dict__.items()}
 
@@ -533,6 +579,17 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
         nz_v = lay.of(row, "nz")
         flags = lay.of(row, "flags")
         zero_req_f, valid_f, has_group_f = flags[0], flags[1], flags[2]
+        if use_gang:
+            grow_v = lay.of(row, "gangrow")
+            gid = grow_v[0].astype(jnp.int32)
+            is_gang = grow_v[1] > 0
+            # gangs are contiguous: a gang-id change means the previous
+            # gang is closed (fully placed or already failed) — its delta
+            # accumulator resets for the newly-opened gang
+            gang_delta = jnp.where(gid != carry["cur_gang"], 0.0,
+                                   carry["gang_delta"])
+            g_failed = carry["gang_failed"][gid] > 0
+            g_dom = carry["gang_dom"][gid]
 
         # --- dynamic predicates (PodFitsResources) ---------------------------
         used = nstate[:4]                   # [4, N]
@@ -559,6 +616,11 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
         else:
             res_fit = jnp.all(used[:3] + req_v[:3, None] <= allocT[:3], axis=0)
             mask = x["mask"] & pod_count_ok & ((zero_req_f > 0) | res_fit)
+        if use_preempt:
+            # everything preemption can't relieve: the full mask EXCEPT the
+            # resource rows (victim eviction frees cpu/mem/gpu/pod-slots
+            # only; ports/disks/affinity keep their current-state verdicts)
+            nonres = x["mask"]
 
         # --- vocab features: ports + volumes (predicates.go:64-269,687) ------
         if use_vocab:
@@ -585,21 +647,33 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
                 else:
                     gce_hit = gce_hit + cols[si]
             if feats.ports:
-                mask = mask & (port_clash == 0.0)
+                port_ok = port_clash == 0.0
+                mask = mask & port_ok
+                if use_preempt:
+                    nonres = nonres & port_ok
             if explain:
                 surv_rows.append(mask)          # row: host ports
             if feats.disk:
-                mask = mask & (disk_clash == 0.0)
+                disk_ok = disk_clash == 0.0
+                mask = mask & disk_ok
+                if use_preempt:
+                    nonres = nonres & disk_ok
             if explain:
                 surv_rows.append(mask)          # row: disk conflict
             if feats.ebs:
                 cnt_e = lay.of(row, "vol_cnt")[0]
                 union = nstate[_R_EBS] + cnt_e - ebs_hit
-                mask = mask & ((cnt_e == 0.0) | (union <= t["max_ebs"]))
+                ebs_ok = (cnt_e == 0.0) | (union <= t["max_ebs"])
+                mask = mask & ebs_ok
+                if use_preempt:
+                    nonres = nonres & ebs_ok
             if feats.gce:
                 cnt_g = lay.of(row, "vol_cnt")[1]
                 union = nstate[_R_GCE] + cnt_g - gce_hit
-                mask = mask & ((cnt_g == 0.0) | (union <= t["max_gce"]))
+                gce_ok = (cnt_g == 0.0) | (union <= t["max_gce"])
+                mask = mask & gce_ok
+                if use_preempt:
+                    nonres = nonres & gce_ok
             if explain:
                 surv_rows.append(mask)          # row: attach-count caps
         elif explain:
@@ -643,13 +717,33 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
             viol = ip2[0] if viol is None else viol + ip2[0]
             c = ip2[1] if c is None else c + ip2[1]
         if viol is not None:
-            mask = mask & (viol == 0.0)
+            ip_ok = viol == 0.0
+            mask = mask & ip_ok
+            if use_preempt:
+                nonres = nonres & ip_ok
         if explain:
             surv_rows.append(mask)              # row: inter-pod affinity
-            # the ONE stacked masked reduction: 8 cumulative masks -> counts
+        if use_gang:
+            # gang members only land on nodes carrying the topology label,
+            # inside the domain the gang's first member chose; members of
+            # an already-failed gang are masked out entirely
+            gang_allow = jnp.where(
+                is_gang,
+                (t["node_gang_dom"] >= 0)
+                & ((g_dom < 0) | (t["node_gang_dom"] == g_dom))
+                & jnp.logical_not(g_failed),
+                True)
+            mask = mask & gang_allow
+            if use_preempt:
+                nonres = nonres & gang_allow
+            if explain:
+                surv_rows.append(mask)          # row: gang topology
+        if explain:
+            # the ONE stacked masked reduction: cumulative masks -> counts
+            # (8 rows; 9 with the gang-topology row)
             dyn_surv = jnp.sum(
                 jnp.stack([r.astype(jnp.float32) for r in surv_rows]),
-                axis=1)                                        # [8]
+                axis=1)
 
         # --- dynamic scores --------------------------------------------------
         tot_c = used_nz[0] + nz_v[0]
@@ -748,6 +842,19 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
             score = score + c_im
             if explain:
                 comps.append(c_im)
+        if use_binpack:
+            # MostRequested fragmentation minimizer ("Priority Matters"):
+            # floor(used*10/cap) per resource, cpu/mem averaged — the exact
+            # integer-truncation mirror of _calculate_score inverted
+            bcpu = jnp.where((cap_c > 0) & (tot_c <= cap_c),
+                             jnp.floor(tot_c * 10.0 / cap_c), 0.0)
+            bmem = jnp.where((cap_m > 0) & (tot_m <= cap_m),
+                             jnp.floor(tot_m * 10.0 / cap_m), 0.0)
+            c_bp = np.float32(obj.binpack_weight) * jnp.floor(
+                (bcpu + bmem) / 2.0)
+            score = score + c_bp
+            if explain:
+                comps.append(c_bp)
 
         # --- selectHost: max + round-robin tie-break -------------------------
         masked_score = jnp.where(mask, score, NEG)
@@ -759,8 +866,78 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
         chosen = jnp.argmax(is_max & (cum == k + 1)).astype(jnp.int32)
         chosen = jnp.where(feasible, chosen, jnp.int32(-1))
 
+        # --- objective: gang all-or-nothing rollback -------------------------
+        if use_gang:
+            # a gang member with zero feasible nodes fails its whole gang:
+            # every prior member's nstate delta reverses inside the scan, so
+            # subsequent (non-gang) pods see the freed capacity
+            fail_now = is_gang & jnp.logical_not(g_failed) \
+                & jnp.logical_not(feasible)
+            failf = fail_now.astype(jnp.float32)
+            nstate = nstate - gang_delta * failf
+            gang_delta = gang_delta * (1.0 - failf)
+
+        # --- objective: priority preemption (masked argmin victim select) ----
+        if use_preempt:
+            can_p = jnp.logical_not(feasible) & (valid_f > 0)
+            if use_gang:
+                can_p = can_p & jnp.logical_not(is_gang)  # gangs never preempt
+            pod_prio = lay.of(row, "prio")[0]
+            ev = carry["evicted"]                         # [N] f32 exact ints
+            kr = jnp.arange(KV + 1, dtype=jnp.float32)    # victim counts 0..KV
+            # prefix gathers offset by the victims this solve already
+            # evicted per node: relief of k MORE victims = cum[e+k] - cum[e]
+            idx = jnp.clip(ev[None, :] + kr[:, None], 0.0,
+                           np.float32(KV)).astype(jnp.int32)       # [KV+1, N]
+            cum_k = jnp.take_along_axis(vict_cum, idx[None, :, :], axis=1)
+            cum_e = jnp.take_along_axis(
+                vict_cum, ev.astype(jnp.int32)[None, None, :], axis=1)
+            relief = cum_k - cum_e                        # [6, KV+1, N]
+            # the k-th victim's priority gates k: sorted ascending, so all k
+            # victims are strictly lower-priority iff the k-th one is
+            # (never preempt equal-or-higher — reference pod-priority rule);
+            # INF padding keeps k beyond the candidate list ineligible
+            jp_ = jnp.clip(ev[None, :] + kr[:, None] - 1.0, 0.0,
+                           np.float32(KV - 1)).astype(jnp.int32)
+            top_prio = jnp.take_along_axis(vict_prio, jp_, axis=0)  # [KV+1, N]
+            okk = (kr[:, None] >= 1.0) & (top_prio < pod_prio)
+            fit = (used[3][None, :] - relief[3] + 1.0
+                   <= allocT[3][None, :]) & nonres[None, :]
+            for r in range(3):
+                fit = fit & ((zero_req_f > 0)
+                             | (used[r][None, :] - relief[r] + req_v[r]
+                                <= allocT[r][None, :]))
+            BIGK = np.float32(1e9)
+            kcand = jnp.where(fit & okk, kr[:, None], BIGK)
+            kmin = jnp.min(kcand, axis=0)                 # [N] min victims
+            has = kmin < BIGK
+            # nominated node = lexicographic argmin of (highest victim
+            # priority, victim count, canonical node order)
+            jsel = jnp.clip(ev + kmin - 1.0, 0.0,
+                            np.float32(KV - 1)).astype(jnp.int32)
+            topsel = jnp.take_along_axis(vict_prio, jsel[None, :], axis=0)[0]
+            m1 = jnp.min(jnp.where(has, topsel, np.float32(1e18)))
+            elig2 = has & (topsel == m1)
+            m2 = jnp.min(jnp.where(elig2, kmin, BIGK))
+            pnode = jnp.argmax(elig2 & (kmin == m2)).astype(jnp.int32)
+            do_p = can_p & jnp.any(has)
+            do_pf = do_p.astype(jnp.float32)
+            k_sel = jnp.where(do_p, m2, 0.0)
+            m2i = jnp.clip(m2, 0.0, np.float32(KV)).astype(jnp.int32)
+            rel_col = jax.lax.dynamic_slice(
+                relief, (0, 0, pnode), (6, KV + 1, 1))[:, :, 0]   # [6, KV+1]
+            rel_sel = jax.lax.dynamic_slice(
+                rel_col, (jnp.int32(0), m2i), (6, 1))[:, 0] * do_pf
+            ponehot = (idx_n == pnode).astype(jnp.float32) * do_pf
+            # relieve the victims' resource occupancy (used + used_nz rows)
+            # and commit the preemptor at the nominated node below
+            nstate = nstate - jnp.concatenate(
+                [rel_sel, jnp.zeros((nstate.shape[0] - 6,), jnp.float32)]
+            )[:, None] * ponehot[None, :]
+            chosen = jnp.where(do_p, pnode, chosen)
+
         # --- commit (the on-device AssumePod) --------------------------------
-        commit = feasible
+        commit = (feasible | do_p) if use_preempt else feasible
         commitf = commit.astype(jnp.float32)
         safe = jnp.maximum(chosen, 0)
         onehot = ((idx_n == safe).astype(jnp.float32)) * commitf
@@ -788,6 +965,19 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
             lay.of(row, "in_group")]) * commitf                # [R]
         out = {"nstate": nstate + inc[:, None] * onehot[None, :],
                "rr": rr + commit.astype(jnp.int32)}
+        if use_preempt:
+            out["evicted"] = ev + k_sel * ponehot
+        if use_gang:
+            # a member commit accumulates its exact nstate delta into the
+            # open gang's rollback buffer; the first commit pins the gang's
+            # topology domain
+            out["gang_delta"] = gang_delta + (
+                inc[:, None] * onehot[None, :]) * jnp.where(is_gang, 1.0, 0.0)
+            out["gang_failed"] = carry["gang_failed"].at[gid].max(failf)
+            new_dom = jnp.where((g_dom < 0) & commit & is_gang,
+                                t["node_gang_dom"][safe], g_dom)
+            out["gang_dom"] = carry["gang_dom"].at[gid].set(new_dom)
+            out["cur_gang"] = gid
 
         if use_vocab:
             out["vocab"] = vocab.at[chan_idx, sids, safe].max(svals * commitf)
@@ -813,45 +1003,70 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
             out["req_nomatch"] = carry["req_nomatch"] & ~(
                 (req_match_v > 0) & commit)
 
-        if not explain:
+        if not explain and not obj_on:
             return out, chosen
 
-        # --- explain extras: winner/runner-up score decomposition ------------
-        comp_stack = jnp.stack(comps)                          # [C, N]
-        Cn = comp_stack.shape[0]
-        win_comp = jax.lax.dynamic_slice(
-            comp_stack, (0, safe), (Cn, 1))[:, 0]              # [C]
-        # runner-up: best masked score excluding the winner (NEG when the
-        # feasible set has no second node — decoded to "no runner-up")
-        run_masked = jnp.where(idx_n == safe, NEG, masked_score)
-        run_total = jnp.max(run_masked)
-        run_idx = jnp.argmax(run_masked).astype(jnp.int32)
-        run_comp = jax.lax.dynamic_slice(
-            comp_stack, (0, run_idx), (Cn, 1))[:, 0]
-        return out, (chosen, {
-            "surv": dyn_surv, "win_comp": win_comp, "win_total": max_score,
-            "run_idx": run_idx, "run_total": run_total, "run_comp": run_comp,
-        })
+        if explain:
+            # --- explain extras: winner/runner-up score decomposition --------
+            comp_stack = jnp.stack(comps)                      # [C, N]
+            Cn = comp_stack.shape[0]
+            win_comp = jax.lax.dynamic_slice(
+                comp_stack, (0, safe), (Cn, 1))[:, 0]          # [C]
+            # runner-up: best masked score excluding the winner (NEG when the
+            # feasible set has no second node — decoded to "no runner-up")
+            run_masked = jnp.where(idx_n == safe, NEG, masked_score)
+            run_total = jnp.max(run_masked)
+            run_idx = jnp.argmax(run_masked).astype(jnp.int32)
+            run_comp = jax.lax.dynamic_slice(
+                comp_stack, (0, run_idx), (Cn, 1))[:, 0]
+            extras = {
+                "surv": dyn_surv, "win_comp": win_comp,
+                "win_total": max_score, "run_idx": run_idx,
+                "run_total": run_total, "run_comp": run_comp,
+            }
+        if not obj_on:
+            return out, (chosen, extras)
+        objy = {}
+        if use_preempt:
+            objy["pk"] = k_sel.astype(jnp.int32)
+        if explain:
+            return out, (chosen, objy, extras)
+        return out, (chosen, objy)
 
     # unroll amortizes per-iteration loop overhead; the body is tiny
     # (elementwise over N + a few [T, N] contractions) so overhead dominates
-    if not explain:
-        _, assignments = jax.lax.scan(step, init, xs, unroll=8)
-        return assignments
-    _, (assignments, extras) = jax.lax.scan(step, init, xs, unroll=8)
-    return assignments, extras
+    if not obj_on:
+        if not explain:
+            _, assignments = jax.lax.scan(step, init, xs, unroll=8)
+            return assignments
+        _, (assignments, extras) = jax.lax.scan(step, init, xs, unroll=8)
+        return assignments, extras
+    carry_f, ys = jax.lax.scan(step, init, xs, unroll=8)
+    if explain:
+        assignments, objy, extras = ys
+    else:
+        assignments, objy = ys
+    objout = dict(objy)
+    if use_gang:
+        objout["gang_failed"] = carry_f["gang_failed"]
+    if explain:
+        return assignments, objout, extras
+    return assignments, objout
 
 
 # --- public API ---------------------------------------------------------------
 
 # integer fields that stay integral on device (indices, not indicators)
-_INT_FIELDS = frozenset(("zone_id", "host_req", "node_dom", "pod_group"))
+_INT_FIELDS = frozenset(("zone_id", "host_req", "node_dom", "pod_group",
+                         "pod_gang", "node_gang_dom", "gang_dom0"))
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("n_zones", "weights", "feats", "explain"))
+                   static_argnames=("n_zones", "weights", "feats", "explain",
+                                    "objective"))
 def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
-                  feats: Features, explain: bool = False):
+                  feats: Features, explain: bool = False,
+                  objective: Optional[ObjectiveConfig] = None):
     # indicator/count matrices may arrive packed (int8/int16/int32 — 4x less
     # upload traffic than f32, ops/incremental.py); widen on-device where
     # the MXU wants floats. XLA fuses the casts into the consumers.
@@ -864,11 +1079,19 @@ def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
             t[k] = v.astype(jnp.float32)
     t["n_zones"] = n_zones
     s = static_pass(t, feats, weights, explain=explain)
+    obj_on = objective is not None and objective.enabled
+    if not obj_on:
+        if not explain:
+            return greedy_commit(t, s, weights, feats)
+        assignments, extras = greedy_commit(t, s, weights, feats, explain=True)
+        extras["static_surv"] = s["static_surv"]
+        return assignments, extras
+    ret = greedy_commit(t, s, weights, feats, explain=explain, obj=objective)
     if not explain:
-        return greedy_commit(t, s, weights, feats)
-    assignments, extras = greedy_commit(t, s, weights, feats, explain=True)
+        return ret
+    assignments, objout, extras = ret
     extras["static_surv"] = s["static_surv"]
-    return assignments, extras
+    return assignments, objout, extras
 
 
 def assignments_to_names(out: np.ndarray,
@@ -886,6 +1109,23 @@ def assignments_to_names(out: np.ndarray,
     return result
 
 
+def unpermute_result(ret, perm: List[int]):
+    """Map a gang-ordered solve result back to the caller's pending order.
+
+    `perm` is objectives.gang_order's permutation (ordered[j] ==
+    pending[perm[j]]); only the positional names list needs re-mapping —
+    DecisionRecords and ObjectiveOutcomes are keyed by pod, not position."""
+    def back(names):
+        out: List[Optional[str]] = [None] * len(perm)
+        for j, i in enumerate(perm):
+            out[i] = names[j]
+        return out
+
+    if isinstance(ret, tuple):
+        return (back(ret[0]),) + ret[1:]
+    return back(ret)
+
+
 # static dispatch keys already traced in this process: the first dispatch
 # for a key pays the XLA compile and is attributed to the "compile" stage
 # (and classified against the persistent compile cache); repeats are "solve"
@@ -893,14 +1133,16 @@ _DISPATCHED: set = set()
 
 
 def _dispatch_key(arrays: dict, n_zones: int, weights: Weights,
-                  feats: Features, explain: bool = False) -> tuple:
+                  feats: Features, explain: bool = False,
+                  objective: Optional[ObjectiveConfig] = None) -> tuple:
     shapes = tuple(sorted((k, tuple(v.shape), str(v.dtype))
                           for k, v in arrays.items()))
-    return shapes, n_zones, weights, feats, explain
+    return shapes, n_zones, weights, feats, explain, objective
 
 
 def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
-             stage=None, explain: bool = False):
+             stage=None, explain: bool = False,
+             objective: Optional[ObjectiveConfig] = None):
     """Run the jit'd solve with host materialization as the sync barrier.
 
     `stage(name, fn)` (the watchdog/span hook, ops/watchdog.run_stages) sees
@@ -919,14 +1161,15 @@ def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
     from kubernetes_tpu.observability import profiling
     from kubernetes_tpu.utils import platform as plat
 
-    key = _dispatch_key(arrays, n_zones, weights, feats, explain)
+    key = _dispatch_key(arrays, n_zones, weights, feats, explain, objective)
     first = key not in _DISPATCHED
     name = "compile" if first else "solve"
 
     def _run():
         before = plat.compile_cache_snapshot() if first else None
         t0 = _time.perf_counter()
-        pending = _schedule_jit(arrays, n_zones, weights, feats, explain)
+        pending = _schedule_jit(arrays, n_zones, weights, feats, explain,
+                                objective)
         t_host = _time.perf_counter()
         # device execution + D2H, the sync barrier (every leaf when explain)
         out = jax.tree_util.tree_map(np.asarray, pending)
@@ -943,14 +1186,23 @@ def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
 
 
 def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
-                   device=None, stage=None, explain: bool = False):
+                   device=None, stage=None, explain: bool = False,
+                   objective: Optional[ObjectiveConfig] = None):
     """Schedule a tensorized batch; returns node name (or None) per pending
     pod, FIFO order. With explain, returns (names, decision records) — the
     records carry per-predicate survivor counts and winner/runner-up score
-    decompositions decoded by observability/explain.py."""
+    decompositions decoded by observability/explain.py.
+
+    With an enabled objective (the ct must have been tensorized with the
+    same config), the return grows an ObjectiveOutcome:
+    (names, outcome) or (names, records, outcome) — preempted pods and
+    rejected-gang members read as unplaced in `names`, with the nominated
+    node / victim sets / gang verdicts on the outcome."""
     weights = weights or Weights()
     feats = features_of(ct)
     run = stage or (lambda _n, fn: fn())
+    from kubernetes_tpu.scheduler.objectives.config import resolve_objective
+    objective = resolve_objective(objective)
 
     def _upload():
         import time as _time
@@ -971,10 +1223,36 @@ def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
 
     arrays = run("upload", _upload)
     out = dispatch(arrays, ct.n_zones, weights, feats, stage=stage,
-                   explain=explain)
-    if not explain:
-        return assignments_to_names(out, ct)
-    out, extras = out
+                   explain=explain, objective=objective)
+    return decode_dispatch(ct, out, weights, feats, explain, objective)
+
+
+def decode_dispatch(ct: ClusterTensors, out, weights: Weights,
+                    feats: Features, explain: bool,
+                    objective: Optional[ObjectiveConfig] = None):
+    """Shared host decode for the full and incremental paths: assignments ->
+    names, explain extras -> DecisionRecords, objective outputs ->
+    ObjectiveOutcome (with the all-or-nothing / nominated-not-bound view
+    applied to names)."""
+    if objective is None:
+        if not explain:
+            return assignments_to_names(out, ct)
+        out, extras = out
+        names = assignments_to_names(out, ct)
+        from kubernetes_tpu.observability.explain import decode_batch
+        return names, decode_batch(ct, out, extras, weights, feats)
+    from kubernetes_tpu.scheduler.objectives.decode import decode_objective
+    if explain:
+        out, objout, extras = out
+    else:
+        out, objout = out
     names = assignments_to_names(out, ct)
+    outcome = decode_objective(ct, out, objout, objective, names)
+    if not explain:
+        return names, outcome
     from kubernetes_tpu.observability.explain import decode_batch
-    return names, decode_batch(ct, out, extras, weights, feats)
+    from kubernetes_tpu.scheduler.objectives.decode import annotate_records
+    records = decode_batch(ct, out, extras, weights, feats,
+                           objective=objective)
+    annotate_records(records, outcome)
+    return names, records, outcome

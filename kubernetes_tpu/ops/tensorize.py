@@ -172,6 +172,18 @@ class ClusterTensors:
     n_real_nodes: int = 0
     n_real_pods: int = 0
 
+    # scheduling-objective operands (scheduler/objectives/tensors.py) —
+    # None unless the batch was tensorized with an enabled ObjectiveConfig,
+    # so the default program's input signature (and jit key) is untouched
+    pod_priority: Optional[np.ndarray] = None   # [P] f32 (preempt)
+    vict_prio: Optional[np.ndarray] = None      # [KV, N] f32 (preempt)
+    vict_cum: Optional[np.ndarray] = None       # [6, KV+1, N] f32 (preempt)
+    pod_gang: Optional[np.ndarray] = None       # [P] i32 (gang; null=GG-1)
+    gang_dom0: Optional[np.ndarray] = None      # [GG] i32 (gang)
+    gang_failed0: Optional[np.ndarray] = None   # [GG] f32 (gang)
+    node_gang_dom: Optional[np.ndarray] = None  # [N] i32 (gang)
+    objective_info: Optional[object] = None     # host-side decode companion
+
     def arrays(self) -> dict:
         """All ndarray fields, for device upload."""
         return {k: v for k, v in self.__dict__.items()
@@ -219,9 +231,17 @@ class Tensorizer:
     spread group, mirroring SelectorSpread's lister usage."""
 
     def __init__(self, plugin_args=None,
-                 failure_domains=(api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION)):
+                 failure_domains=(api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION),
+                 objective=None):
         self.args = plugin_args
         self.failure_domains = tuple(failure_domains)
+        # enabled ObjectiveConfig -> the objective operand arrays ride the
+        # batch (scheduler/objectives/tensors.py); None/default -> layout
+        # unchanged
+        from kubernetes_tpu.scheduler.objectives.config import (
+            resolve_objective,
+        )
+        self.objective = resolve_objective(objective)
 
     # -- public ---------------------------------------------------------------
 
@@ -383,6 +403,26 @@ class Tensorizer:
         # --- volumes ---------------------------------------------------------
         volumes = self._volume_tensors(existing, pending, node_index, Np, Pp)
 
+        # --- scheduling objectives (scheduler/objectives/tensors.py) ---------
+        objective_kw = {}
+        if self.objective is not None:
+            from kubernetes_tpu.scheduler.objectives.tensors import (
+                build_objective_tensors,
+            )
+            node_labels_d = {i: _labels_of(n) for i, n in enumerate(nodes)}
+            # victim candidates: placed pods on listed nodes, excluding
+            # terminating ones (a pod already on its way out is not a
+            # victim worth nominating)
+            placed = [
+                (ep, node_index[ep.spec.node_name]) for ep in existing
+                if ep.spec and ep.spec.node_name in node_index
+                and not (ep.metadata and ep.metadata.deletion_timestamp)]
+            arrays, info = build_objective_tensors(
+                self.objective, pending, Pp, Np,
+                lambda slot: node_labels_d.get(slot, {}), placed)
+            objective_kw = dict(arrays)
+            objective_kw["objective_info"] = info
+
         return ClusterTensors(
             node_names=[n.metadata.name for n in nodes],
             pod_keys=[f"{p.metadata.namespace}/{p.metadata.name}" for p in pending],
@@ -404,7 +444,7 @@ class Tensorizer:
             group_counts0=group_counts0, n_groups=n_groups,
             image_node_sizes=image_node_sizes, pod_images=pod_images,
             n_real_nodes=N, n_real_pods=P,
-            **interpod, **volumes,
+            **interpod, **volumes, **objective_kw,
         )
 
     # -- node affinity --------------------------------------------------------

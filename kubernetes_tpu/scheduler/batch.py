@@ -128,14 +128,31 @@ def oracle_batch(nodes: List[api.Node], existing: List[api.Pod],
 def tpu_batch(nodes: List[api.Node], existing: List[api.Pod],
               pending: List[api.Pod], args: PluginArgs,
               weights: Optional[Weights] = None,
-              stage=None, explain: bool = False):
+              stage=None, explain: bool = False, objective=None):
     """The TPU path: tensorize + device kernel. `stage(name, fn)` is the
     watchdog/span hook (ops/watchdog.run_stages) naming the pipeline stages
     tensorize -> upload -> compile|solve. With explain, returns
     (names, DecisionRecords) — per-predicate provenance straight from the
-    solve (observability/explain.py)."""
+    solve (observability/explain.py). With an enabled objective
+    (name or ObjectiveConfig — scheduler/objectives), the return grows an
+    ObjectiveOutcome: (names, outcome) / (names, records, outcome)."""
+    from kubernetes_tpu.scheduler.objectives.config import (
+        gang_order, resolve_objective,
+    )
+    objective = resolve_objective(objective)
+    perm = None
+    if objective is not None and objective.gang:
+        # gang members must be contiguous in scan order; solve in the
+        # gang-grouped order and un-permute the names below
+        pending, perm = gang_order(pending)
     run = stage or (lambda _n, fn: fn())
     ct = run("tensorize",
-             lambda: Tensorizer(plugin_args=args).build(nodes, existing,
-                                                        pending))
-    return schedule_batch(ct, weights, stage=stage, explain=explain)
+             lambda: Tensorizer(plugin_args=args,
+                                objective=objective).build(nodes, existing,
+                                                           pending))
+    ret = schedule_batch(ct, weights, stage=stage, explain=explain,
+                         objective=objective)
+    if perm is None:
+        return ret
+    from kubernetes_tpu.ops.kernel import unpermute_result
+    return unpermute_result(ret, perm)

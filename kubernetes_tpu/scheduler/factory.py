@@ -192,15 +192,18 @@ class ConfigFactory:
     def create_batch_from_provider(self, provider_name: str = DEFAULT_PROVIDER,
                                    batch_size: int = 4096, weights=None,
                                    strict: bool = False,
-                                   stage_deadlines=None, explain=None):
+                                   stage_deadlines=None, explain=None,
+                                   objective=None):
         """The TPU-backed batch scheduler (scheduler/tpu.py) with the oracle
-        from the same provider as its device-failure fallback."""
+        from the same provider as its device-failure fallback. `objective`
+        selects a registered scheduling-objective mode
+        (scheduler/objectives: binpack / preempt / gang / combinations)."""
         from kubernetes_tpu.scheduler.tpu import create_batch_scheduler
         return create_batch_scheduler(self, provider_name,
                                       batch_size=batch_size, weights=weights,
                                       strict=strict,
                                       stage_deadlines=stage_deadlines,
-                                      explain=explain)
+                                      explain=explain, objective=objective)
 
     # --- lifecycle -----------------------------------------------------------
 

@@ -1,0 +1,17 @@
+"""Scheduling objectives: bin-packing, priority preemption, gang placement
+as tensor solve modes behind the algorithm-provider seam (ROADMAP 3/5).
+
+- ``config``  ObjectiveConfig + the named-objective registry (the provider
+              pattern: objectives are config choices, not kernel forks)
+- ``tensors`` the extra device operands each mode solves on, shared by the
+              full Tensorizer and the incremental mirror
+- ``decode``  host decode of kernel objective outputs -> ObjectiveOutcome
+              (victim sets, nominated nodes, gang verdicts)
+- ``oracle``  the node-by-node Python replay every mode must match exactly
+"""
+
+from kubernetes_tpu.scheduler.objectives.config import (  # noqa: F401
+    DEFAULT_OBJECTIVE, GANG_LABEL, PRIORITY_ANNOTATION, ObjectiveConfig,
+    gang_order, get_objective, objective_names, pod_gang, pod_priority,
+    register_objective,
+)

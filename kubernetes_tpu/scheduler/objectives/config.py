@@ -1,0 +1,164 @@
+"""Scheduling-objective configuration + registry (ROADMAP items 3/5).
+
+An ``ObjectiveConfig`` is a frozen, hashable description of which solve
+modes the kernel traces — it rides the jit static key exactly like
+``Weights``/``Features`` (ops/kernel.py), so every named objective is one
+compiled program and the default config IS the pre-objective kernel
+program, bit for bit.
+
+Three built-in modes, composable:
+
+- ``binpack``   fragmentation-minimizing score term (MostRequested over the
+                node resource tensor — "Priority Matters", arxiv 2511.08373)
+- ``preempt``   priority preemption: a pod with zero feasible nodes selects
+                victims as a masked argmin over (victim priority, victim
+                count) among strictly-lower-priority placed pods, inside the
+                same solve; never preempts equal-or-higher priority
+- ``gang``      all-or-nothing gang placement co-packed onto nodes sharing
+                one topology-label domain (slice/rack — Tesserae, arxiv
+                2508.04953), with partial placements rolled back inside the
+                greedy commit scan
+
+The registry mirrors the algorithm-provider registry (provider.py /
+reference factory/plugins.go): objectives register by name, policy files
+select them by name, and an unknown name is a loud KeyError — the seam that
+turns every future objective into a config choice instead of a kernel fork.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple, Union
+
+from kubernetes_tpu.api import types as api
+
+# pod metadata carrying the objective inputs (v1.3-era alpha style:
+# annotations/labels, no new API fields)
+PRIORITY_ANNOTATION = "scheduler.ktpu.io/priority"
+GANG_LABEL = "scheduler.ktpu.io/gang"
+
+# victim priorities are small integers; this sentinel sorts after any of
+# them in f32 without precision loss
+INF_PRIORITY = 1e9
+
+
+@dataclass(frozen=True)
+class ObjectiveConfig:
+    """Static solve-mode selection (hashable: part of the jit static key)."""
+
+    name: str = "default"
+    binpack: bool = False
+    preempt: bool = False
+    gang: bool = False
+    binpack_weight: int = 1
+    gang_topology_key: str = api.LABEL_ZONE
+
+    @property
+    def enabled(self) -> bool:
+        """Any non-default mode traced. An all-off config selects the exact
+        default kernel program."""
+        return self.binpack or self.preempt or self.gang
+
+
+DEFAULT_OBJECTIVE = ObjectiveConfig()
+
+_OBJECTIVES: Dict[str, ObjectiveConfig] = {}
+
+
+def register_objective(name: str, config: ObjectiveConfig) -> str:
+    """Register a named objective (the provider-registry pattern)."""
+    if not isinstance(config, ObjectiveConfig):
+        raise TypeError(f"objective {name!r} must be an ObjectiveConfig, "
+                        f"got {type(config).__name__}")
+    _OBJECTIVES[name] = config
+    return name
+
+
+def get_objective(
+        name: Union[str, ObjectiveConfig, None]) -> Optional[ObjectiveConfig]:
+    """Resolve a name/config/None to an ObjectiveConfig (None and the
+    default config both mean "default kernel program"). Unknown names raise
+    KeyError, matching get_provider/get_predicates."""
+    if name is None:
+        return None
+    if isinstance(name, ObjectiveConfig):
+        return name
+    if name not in _OBJECTIVES:
+        raise KeyError(f"unknown scheduling objective {name!r}")
+    return _OBJECTIVES[name]
+
+
+def resolve_objective(
+        name: Union[str, ObjectiveConfig, None],
+        env: bool = False) -> Optional[ObjectiveConfig]:
+    """get_objective plus the disabled normalization every consumer needs:
+    None and an all-off config both select the default kernel program and
+    resolve to None, so callers gate on ``objective is not None`` alone.
+    With env=True a None name falls back to KTPU_OBJECTIVE first (the
+    seam the soak and smoke tools use)."""
+    if name is None and env:
+        import os
+        name = os.environ.get("KTPU_OBJECTIVE") or None
+    cfg = get_objective(name)
+    return cfg if cfg is not None and cfg.enabled else None
+
+
+def objective_names() -> List[str]:
+    return sorted(_OBJECTIVES)
+
+
+register_objective("default", DEFAULT_OBJECTIVE)
+register_objective("binpack", ObjectiveConfig(name="binpack", binpack=True))
+register_objective("preempt", ObjectiveConfig(name="preempt", preempt=True))
+register_objective("gang", ObjectiveConfig(name="gang", gang=True))
+# the training-cluster shape (Tesserae + Priority Matters together): gangs
+# co-packed by topology AND priority pods preempting when the cluster fills
+register_objective("gang_preempt", ObjectiveConfig(
+    name="gang_preempt", gang=True, preempt=True))
+
+
+# --- pod-side inputs ----------------------------------------------------------
+
+def pod_priority(pod: api.Pod) -> float:
+    """Scheduling priority from the alpha annotation; 0 when absent or
+    unparseable (a malformed annotation must not unschedule the pod)."""
+    ann = (pod.metadata.annotations or {}) if pod.metadata else {}
+    raw = ann.get(PRIORITY_ANNOTATION)
+    if raw is None:
+        return 0.0
+    try:
+        return float(int(raw))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def pod_gang(pod: api.Pod) -> Optional[str]:
+    """Namespace-qualified gang identity from the gang label, or None.
+    Qualification matters: two teams independently labelling their jobs
+    gang=train must NOT be fused into one all-or-nothing unit (one team's
+    infeasible member would nullify the other team's placements). This is
+    the single accessor — tensors, oracle, intake, and counters all key
+    gangs through it."""
+    if pod.metadata is None:
+        return None
+    g = (pod.metadata.labels or {}).get(GANG_LABEL)
+    if not g:
+        return None
+    return f"{pod.metadata.namespace or 'default'}/{g}"
+
+
+def gang_order(pending: List[api.Pod]) -> Tuple[List[api.Pod], List[int]]:
+    """Stable reorder making gang members contiguous (at the position of
+    each gang's first arrival) — the batch-order policy gang mode solves
+    under, so the scan holds at most ONE open gang at a time. Returns
+    (ordered pods, perm) with ordered[j] == pending[perm[j]]; callers map
+    kernel outputs back via out[perm[j]] = result[j]."""
+    first: Dict[str, int] = {}
+    for i, pod in enumerate(pending):
+        g = pod_gang(pod)
+        if g is not None and g not in first:
+            first[g] = i
+    order = sorted(range(len(pending)), key=lambda i: (
+        first.get(pod_gang(pending[i]) or "", i)
+        if pod_gang(pending[i]) is not None else i, i))
+    return [pending[i] for i in order], order

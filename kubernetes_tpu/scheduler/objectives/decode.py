@@ -1,0 +1,180 @@
+"""Host decode of the kernel's objective outputs into an ObjectiveOutcome.
+
+The kernel surfaces two raw facts per solve: a per-pod victim count
+(``pk``, 0 = no preemption) and the final per-gang failed flags. Everything
+operator-facing — which victims, which nominated node, which gangs placed —
+is reconstructed here by replaying the scan's pod order against the
+host-side victim order the tensorizer recorded, exactly like
+assignments_to_names is the one decoder for assignments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.scheduler.generic import FitError
+
+
+@dataclass
+class PreemptionDecision:
+    """One preemptor's nomination: the node and the exact victim set."""
+
+    pod: str                      # preemptor, ns/name
+    node: str                     # nominated node
+    victims: List[str]            # ns/name, eviction order (priority asc)
+
+
+@dataclass
+class GangResult:
+    name: str
+    members: List[str]
+    placed: bool
+
+
+@dataclass
+class ObjectiveOutcome:
+    objective: str = "default"
+    preemptions: List[PreemptionDecision] = field(default_factory=list)
+    gangs: List[GangResult] = field(default_factory=list)
+
+    @property
+    def gangs_placed(self) -> int:
+        return sum(1 for g in self.gangs if g.placed)
+
+    @property
+    def gangs_rejected(self) -> int:
+        return sum(1 for g in self.gangs if not g.placed)
+
+    def to_dict(self) -> dict:
+        return {
+            "objective": self.objective,
+            "preemptions": [
+                {"pod": p.pod, "node": p.node, "victims": list(p.victims)}
+                for p in self.preemptions],
+            "gangs": [{"name": g.name, "members": list(g.members),
+                       "placed": g.placed} for g in self.gangs],
+        }
+
+
+def preemption_message(node: str, victims: List[str]) -> str:
+    """The ONE preemption sentence every surface carries (FailedScheduling
+    event, Unschedulable condition, /explainz reason) — agreement across
+    them is asserted live by tools/objectives_smoke.py."""
+    return (f"0 nodes were immediately available; nominated node "
+            f"{node} after preempting {len(victims)} "
+            f"lower-priority pod(s): {', '.join(victims)}")
+
+
+class PreemptionFitError(FitError):
+    """The preemptor's scheduling 'failure': not bound this round, but with
+    victims evicted and the nominated node on the condition/event (the
+    reference's nominatedNodeName flow)."""
+
+    def __init__(self, pod, decision: PreemptionDecision):
+        FitError.__init__(self, pod, {})
+        self.decision = decision
+        self.signature = ("Preemption",)
+        self._message = preemption_message(decision.node, decision.victims)
+
+    def __str__(self) -> str:
+        return self._message
+
+
+class GangFitError(FitError):
+    """A gang member rejected because its gang could not be co-placed."""
+
+    def __init__(self, pod, gang: GangResult, message: Optional[str] = None):
+        FitError.__init__(self, pod, {})
+        self.gang = gang
+        self.signature = ("GangRejected", gang.name)
+        self._message = message or (
+            f"gang {gang.name!r} rejected: {len(gang.members)} member(s) "
+            f"could not be co-placed all-or-nothing on one "
+            f"topology domain")
+
+    def __str__(self) -> str:
+        return self._message
+
+
+def decode_objective(ct, out, objout: dict, objective,
+                     names: List[Optional[str]]) -> ObjectiveOutcome:
+    """Decode raw kernel objective outputs; mutates `names` to the
+    host-visible all-or-nothing / not-bound view (gang-rejected members and
+    preemptors read as unplaced)."""
+    import numpy as np
+
+    outcome = ObjectiveOutcome(objective=objective.name)
+    oi = getattr(ct, "objective_info", None)
+
+    if objective.preempt and "pk" in objout:
+        pk = np.asarray(objout["pk"])
+        evicted: Dict[int, int] = {}
+        order = oi.victim_order if oi is not None else []
+        for i in range(ct.n_real_pods):
+            k = int(pk[i])
+            if k <= 0:
+                continue
+            n = int(out[i])
+            e = evicted.get(n, 0)
+            victims = (order[n][e:e + k]
+                       if 0 <= n < len(order) else [])
+            evicted[n] = e + k
+            outcome.preemptions.append(PreemptionDecision(
+                pod=ct.pod_keys[i],
+                node=ct.node_names[n] if 0 <= n < len(ct.node_names) else "",
+                victims=list(victims)))
+            names[i] = None   # nominated, not bound this round
+
+    if objective.gang and "gang_failed" in objout and oi is not None:
+        failed = np.asarray(objout["gang_failed"])
+        by_name = {g: bool(failed[gid] > 0)
+                   for gid, g in enumerate(oi.gang_names)}
+        for g in oi.gang_names:
+            outcome.gangs.append(GangResult(
+                name=g, members=list(oi.gang_members.get(g, [])),
+                placed=not by_name[g]))
+        if any(by_name.values()):
+            gang_of = {}
+            for gid, g in enumerate(oi.gang_names):
+                for m in oi.gang_members.get(g, []):
+                    gang_of[m] = g
+            for i in range(ct.n_real_pods):
+                g = gang_of.get(ct.pod_keys[i])
+                if g is not None and by_name[g]:
+                    names[i] = None   # all-or-nothing: the gang failed
+
+    return outcome
+
+
+def _clear_placement(rec) -> None:
+    """A record with an objective verdict (preemption pending, gang
+    rejected) has no winner this round — blank the placement fields."""
+    rec.node = None
+    rec.score = None
+    rec.components = {}
+    rec.runner_up = None
+    rec.runner_up_score = None
+    rec.runner_up_components = {}
+
+
+def annotate_records(records, outcome: ObjectiveOutcome) -> None:
+    """Stamp decision records (observability/explain.py) with the
+    objective verdicts so /explainz, the FailedScheduling event, and
+    kubectl describe stay truthful in every mode."""
+    by_pod = {r.pod: r for r in records}
+    for pd in outcome.preemptions:
+        rec = by_pod.get(pd.pod)
+        if rec is None:
+            continue
+        _clear_placement(rec)
+        rec.preemption = {"node": pd.node, "victims": list(pd.victims)}
+    for g in outcome.gangs:
+        for m in g.members:
+            rec = by_pod.get(m)
+            if rec is None:
+                continue
+            rec.gang = {"name": g.name,
+                        "outcome": "placed" if g.placed else "rejected"}
+            if not g.placed:
+                _clear_placement(rec)

@@ -1,0 +1,427 @@
+"""The objective oracle: a fully independent node-by-node Python replay of
+the kernel's objective modes (binpack / preempt / gang), built from the SAME
+predicates/priorities the default-mode oracle uses.
+
+This is the ground truth the oracle-equivalence tests pin the kernel
+against: placements, victim sets, nominated nodes, gang verdicts, survivor
+rows, and score decompositions must all match EXACTLY.  Unlike
+explain.oracle_breakdown (which replays scoring at the kernel's
+assignments), this oracle derives its own decisions — same argmax, same
+round-robin tie counter, same preemption argmin — so a kernel bug can't
+vouch for itself.
+
+State-surgery semantics deliberately mirror the kernel's cheap carries
+(ops/kernel.py greedy_commit docstring):
+
+- preemption relieves a victim's RESOURCE occupancy only (cpu/mem/gpu/
+  pod-slot/nonzero rows): the victim's ports, disks, spread membership and
+  affinity hits keep their shadows until the next batch.  Implemented as
+  arithmetic surgery on NodeInfo.requested plus a pod-slot credit — the
+  victim pod object stays in NodeInfo.pods.
+- a rolled-back gang member reverses resources, pod-slot, spread counts and
+  attach counts (NodeInfo.remove_pod) but leaves port/disk occupancy and
+  affinity hits shadowed (a per-node shadow NodeInfo holds the rolled-back
+  pods for the port/disk rows only; the member stays in the pod lister).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.objectives.config import (
+    ObjectiveConfig, pod_gang, pod_priority,
+)
+from kubernetes_tpu.scheduler.objectives.decode import (
+    GangResult, ObjectiveOutcome, PreemptionDecision, annotate_records,
+)
+
+
+def _key(pod: api.Pod) -> str:
+    m = pod.metadata
+    return f"{m.namespace}/{m.name}" if m else ""
+
+
+@dataclass
+class OracleResult:
+    """names: node per pod in input order (None = not bound this round);
+    outcome: the objective verdicts; records: annotated DecisionRecords
+    (the decode_batch + annotate_records shape)."""
+
+    names: List[Optional[str]] = field(default_factory=list)
+    outcome: ObjectiveOutcome = field(default_factory=ObjectiveOutcome)
+    records: list = field(default_factory=list)
+
+
+def oracle_objective(nodes: List[api.Node], existing: List[api.Pod],
+                     pending: List[api.Pod], args,
+                     objective: ObjectiveConfig,
+                     weights=None) -> OracleResult:
+    """Replay the batch under `objective`.  `pending` must already be in
+    gang order (objectives.gang_order) when the config enables gang mode —
+    the same contract the kernel solves under."""
+    from kubernetes_tpu.api.serialization import deep_copy
+    from kubernetes_tpu.observability.explain import (
+        COMPONENT_ORDER, DecisionRecord,
+    )
+    from kubernetes_tpu.ops.kernel import Weights
+    from kubernetes_tpu.scheduler import predicates as preds
+    from kubernetes_tpu.scheduler import priorities as prios
+    from kubernetes_tpu.scheduler.cache import (
+        NodeInfo, pod_nonzero_request, pod_request,
+    )
+
+    w = weights or Weights()
+    wd = dict(w.__dict__)
+    if objective.binpack:
+        wd["binpack"] = objective.binpack_weight
+
+    info: Dict[str, NodeInfo] = {n.metadata.name: NodeInfo(n) for n in nodes}
+    for ep in existing:
+        name = ep.spec.node_name if ep.spec else ""
+        if name in info:
+            info[name].add_pod(ep)
+
+    # shadows: per-node NodeInfo holding ONLY rolled-back gang members —
+    # consulted by the port/disk rows, never by resources/volcaps/spread
+    shadow: Dict[str, NodeInfo] = {n.metadata.name: NodeInfo()
+                                   for n in nodes}
+    # pod-slot credit from preemption evictions (victims stay in .pods so
+    # their port/disk/spread shadows persist; only their slot is freed)
+    pod_credit: Dict[str, int] = {n.metadata.name: 0 for n in nodes}
+
+    # victim candidate tables: the tensorizer's exact order — per node,
+    # placed pods sorted ascending by (priority, ns/name), terminating
+    # excluded; in-batch commits are NOT candidates (tables are built at
+    # tensorize time)
+    victims: Dict[str, List[Tuple[float, str, api.Pod]]] = {}
+    if objective.preempt:
+        for ep in existing:
+            name = ep.spec.node_name if ep.spec else ""
+            if name not in info:
+                continue
+            if ep.metadata and ep.metadata.deletion_timestamp:
+                continue
+            victims.setdefault(name, []).append(
+                (pod_priority(ep), _key(ep), ep))
+        for lst in victims.values():
+            lst.sort(key=lambda e: (e[0], e[1]))
+    evicted: Dict[str, int] = {}
+
+    pvc, pv = getattr(args, "pvc_lookup", None), getattr(args, "pv_lookup",
+                                                         None)
+    vz = preds.VolumeZoneChecker(pvc, pv) if pvc and pv else None
+    vol_ebs = preds.MaxPDVolumeCountChecker(
+        "ebs", preds.DEFAULT_MAX_EBS_VOLUMES, pvc, pv)
+    vol_gce = preds.MaxPDVolumeCountChecker(
+        "gce-pd", preds.DEFAULT_MAX_GCE_PD_VOLUMES, pvc, pv)
+    interpod = preds.InterPodAffinity(args.pod_lister, args.node_lookup)
+    interpod_prio = prios.InterPodAffinityPriority(
+        args.pod_lister, args.node_lookup,
+        getattr(args, "hard_pod_affinity_weight", 1))
+    spread = prios.SelectorSpread(args.service_lister, args.controller_lister,
+                                  args.replicaset_lister)
+    prio_fns = {
+        "least_requested": prios.least_requested,
+        "balanced": prios.balanced_resource_allocation,
+        "spread": spread,
+        "node_affinity": prios.node_affinity_priority,
+        "taint_toleration": prios.taint_toleration_priority,
+        "interpod_affinity": interpod_prio,
+        "image_locality": prios.image_locality_priority,
+        "equal": prios.equal_priority,
+        "binpack": prios.most_requested,
+    }
+    comp_names = [n for n in COMPONENT_ORDER if wd.get(n)]
+
+    topo_key = objective.gang_topology_key
+    gang_domain: Dict[str, Optional[str]] = {}
+    gang_failed: set = set()
+    gang_commits: Dict[str, List[Tuple[api.Pod, str]]] = {}
+    gang_names_seen: List[str] = []
+    gang_members: Dict[str, List[str]] = {}
+
+    rr = 0  # selectHost round-robin counter (increments per commit)
+    result = OracleResult()
+    outcome = result.outcome
+    outcome.objective = objective.name
+
+    def node_label(node: api.Node, key: str) -> Optional[str]:
+        return ((node.metadata.labels or {}) if node.metadata else {}
+                ).get(key)
+
+    def commit(pod: api.Pod, host: str) -> api.Pod:
+        nonlocal rr
+        committed = deep_copy(pod)
+        committed.spec.node_name = host
+        info[host].add_pod(committed)
+        if hasattr(args.pod_lister, "pods"):
+            args.pod_lister.pods.append(committed)
+        rr += 1
+        return committed
+
+    for i, pod in enumerate(pending):
+        req = pod_request(pod)
+        zero_req = (req.milli_cpu == 0 and req.memory == 0 and req.gpu == 0)
+        g = pod_gang(pod) if objective.gang else None
+        if g is not None and g not in gang_members:
+            gang_names_seen.append(g)
+            gang_members[g] = []
+            gang_domain[g] = None
+            gang_commits[g] = []
+        if g is not None:
+            gang_members[g].append(_key(pod))
+
+        sel_pod = deep_copy(pod)
+        if sel_pod.spec:
+            sel_pod.spec.affinity = None
+        aff_pod = deep_copy(pod)
+        if aff_pod.spec:
+            aff_pod.spec.node_selector = None
+
+        def _sel(p, ni):
+            preds.pod_matches_node_selector(sel_pod, ni)
+            if vz is not None:
+                vz(p, ni)
+
+        def _pods_row(p, ni):
+            allowed = ni.allowed_pod_number
+            live = len(ni.pods) - pod_credit[ni.node.metadata.name]
+            if live + 1 > allowed:
+                raise preds.PredicateFailure("Too many pods")
+
+        def _res_row(attr):
+            def chk(p, ni):
+                if zero_req:
+                    return
+                used = getattr(ni.requested, attr)
+                alloc = getattr(ni.allocatable, attr)
+                if used + getattr(req, attr) > alloc:
+                    raise preds.PredicateFailure(f"Insufficient {attr}")
+            return chk
+
+        def _ports(p, ni):
+            preds.pod_fits_host_ports(p, ni)
+            preds.pod_fits_host_ports(p, shadow[ni.node.metadata.name])
+
+        def _disk(p, ni):
+            preds.no_disk_conflict(p, ni)
+            preds.no_disk_conflict(p, shadow[ni.node.metadata.name])
+
+        def _volcap(p, ni):
+            vol_ebs(p, ni)
+            vol_gce(p, ni)
+
+        checks = [
+            _sel,
+            lambda p, ni: preds.pod_matches_node_selector(aff_pod, ni),
+            preds.pod_tolerates_node_taints,
+            preds.check_node_memory_pressure,
+            preds.pod_fits_host,
+            _pods_row, _res_row("milli_cpu"), _res_row("memory"),
+            _res_row("gpu"),
+            _ports, _disk, _volcap,
+            interpod,
+        ]
+        # resource-row indices (preemption can only relieve these)
+        RES_ROWS = (5, 6, 7, 8)
+
+        gang_row = None
+        if objective.gang:
+            failed = g is not None and g in gang_failed
+            dom = gang_domain.get(g) if g is not None else None
+
+            def gang_row(p, ni, _failed=failed, _dom=dom, _is_gang=g is not None):
+                if not _is_gang:
+                    return
+                if _failed:
+                    raise preds.PredicateFailure("gang already failed")
+                val = node_label(ni.node, topo_key)
+                if not val:
+                    raise preds.PredicateFailure("no gang topology label")
+                if _dom is not None and val != _dom:
+                    raise preds.PredicateFailure("wrong gang domain")
+            checks.append(gang_row)
+
+        interpod.begin_pod(pod)
+        cand = list(nodes)
+        surv = []
+        for chk in checks:
+            kept = []
+            for nd in cand:
+                try:
+                    chk(pod, info[nd.metadata.name])
+                    kept.append(nd)
+                except preds.PredicateFailure:
+                    pass
+            cand = kept
+            surv.append(len(cand))
+
+        rec = DecisionRecord(pod=_key(pod), node=None,
+                             nodes_total=len(nodes), survivors=tuple(surv))
+        result.records.append(rec)
+
+        if cand:
+            # --- score + selectHost (the kernel's exact argmax/tie-break) ---
+            raw = {name: prio_fns[name](pod, info, cand)
+                   for name in comp_names}
+            totals = {nd.metadata.name: float(sum(
+                wd[name] * raw[name][nd.metadata.name]
+                for name in comp_names)) for nd in cand}
+            best_score = max(totals.values())
+            ties = [nd.metadata.name for nd in cand
+                    if totals[nd.metadata.name] == best_score]
+            host = ties[rr % len(ties)]
+            rec.node = host
+            rec.score = best_score
+            rec.components = {
+                name: float(wd[name] * raw[name][host])
+                for name in COMPONENT_ORDER if name in comp_names}
+            run_name, run_score = None, None
+            for nd in cand:
+                nm = nd.metadata.name
+                if nm == host:
+                    continue
+                if run_score is None or totals[nm] > run_score:
+                    run_name, run_score = nm, totals[nm]
+            rec.runner_up, rec.runner_up_score = run_name, run_score
+            if run_name is not None:
+                rec.runner_up_components = {
+                    name: float(wd[name] * raw[name][run_name])
+                    for name in COMPONENT_ORDER if name in comp_names}
+            committed = commit(pod, host)
+            if g is not None:
+                gang_commits[g].append((committed, host))
+                if gang_domain[g] is None:
+                    gang_domain[g] = node_label(info[host].node, topo_key)
+            result.names.append(host)
+            continue
+
+        # --- no feasible node ------------------------------------------------
+        if g is not None and g not in gang_failed:
+            # all-or-nothing: fail the gang, roll prior members back
+            gang_failed.add(g)
+            for member, host in gang_commits[g]:
+                info[host].remove_pod(member)
+                # port/disk occupancy deliberately persists (the kernel's
+                # vocab carry is not rolled back) — shadow it
+                shadow[host].pods.append(member)
+            gang_commits[g] = []
+            result.names.append(None)
+            continue
+
+        if objective.preempt and g is None and not zero_req:
+            decision = _try_preempt(pod, req, nodes, info, checks, RES_ROWS,
+                                    victims, evicted, pod_credit)
+        elif objective.preempt and g is None and zero_req:
+            # a zero-request pod gains nothing from resource relief: the
+            # kernel's fit rows are all vacuously true but okk requires a
+            # strictly-lower-priority victim AND the pods row must fit —
+            # replay the same arithmetic
+            decision = _try_preempt(pod, req, nodes, info, checks, RES_ROWS,
+                                    victims, evicted, pod_credit,
+                                    zero_req=True)
+        else:
+            decision = None
+        if decision is not None:
+            pnode, k = decision
+            vl = victims.get(pnode, [])
+            e = evicted.get(pnode, 0)
+            chosen = vl[e:e + k]
+            evicted[pnode] = e + k
+            for _prio, _vkey, vpod in chosen:
+                vr = pod_request(vpod)
+                vnz = pod_nonzero_request(vpod)
+                ni = info[pnode]
+                ni.requested.milli_cpu -= vr.milli_cpu
+                ni.requested.memory -= vr.memory
+                ni.requested.gpu -= vr.gpu
+                ni.non_zero_requested.milli_cpu -= vnz.milli_cpu
+                ni.non_zero_requested.memory -= vnz.memory
+                pod_credit[pnode] += 1
+            commit(pod, pnode)  # occupies the nominated node in-batch
+            outcome.preemptions.append(PreemptionDecision(
+                pod=_key(pod), node=pnode,
+                victims=[vkey for _p, vkey, _pod in chosen]))
+            result.names.append(None)  # nominated, not bound this round
+            continue
+
+        result.names.append(None)
+
+    for g in gang_names_seen:
+        outcome.gangs.append(GangResult(
+            name=g, members=list(gang_members[g]),
+            placed=g not in gang_failed))
+    # the failed-gang / preemption view of names + records, via the SAME
+    # transformation the kernel decode applies
+    key_to_idx = {_key(p): i for i, p in enumerate(pending)}
+    for gr in outcome.gangs:
+        if gr.placed:
+            continue
+        for m in gr.members:
+            result.names[key_to_idx[m]] = None
+    annotate_records(result.records, outcome)
+    return result
+
+
+def _try_preempt(pod, req, nodes, info, checks, res_rows, victims, evicted,
+                 pod_credit, zero_req: bool = False):
+    """The kernel's masked-argmin victim selection, node by node: returns
+    (node, k) — the nomination with the lowest (highest-victim-priority,
+    victim-count, node-order) — or None.  `checks` is this pod's row list;
+    everything except the resource rows must pass on CURRENT state (the
+    kernel's `nonres` mask)."""
+    from kubernetes_tpu.scheduler import predicates as preds
+    from kubernetes_tpu.scheduler.cache import pod_request
+
+    prio = pod_priority(pod)
+    cands = []  # (top_victim_priority, k, node_order)
+    for order, nd in enumerate(nodes):
+        name = nd.metadata.name
+        ni = info[name]
+        ok = True
+        for row, chk in enumerate(checks):
+            if row in res_rows:
+                continue
+            try:
+                chk(pod, ni)
+            except preds.PredicateFailure:
+                ok = False
+                break
+        if not ok:
+            continue
+        vl = victims.get(name, [])
+        e = evicted.get(name, 0)
+        relief_cpu = relief_mem = relief_gpu = 0
+        found_k = None
+        for k in range(1, len(vl) - e + 1):
+            vprio, _vkey, vpod = vl[e + k - 1]
+            if vprio >= prio:
+                break  # sorted ascending: no larger k can qualify either
+            vr = pod_request(vpod)
+            relief_cpu += vr.milli_cpu
+            relief_mem += vr.memory
+            relief_gpu += vr.gpu
+            alloc = ni.allocatable
+            live = len(ni.pods) - pod_credit[name]
+            if live - k + 1 > ni.allowed_pod_number:
+                continue
+            if not zero_req:
+                if ni.requested.milli_cpu - relief_cpu + req.milli_cpu \
+                        > alloc.milli_cpu:
+                    continue
+                if ni.requested.memory - relief_mem + req.memory \
+                        > alloc.memory:
+                    continue
+                if ni.requested.gpu - relief_gpu + req.gpu > alloc.gpu:
+                    continue
+            found_k = k
+            break
+        if found_k is not None:
+            top = vl[e + found_k - 1][0]
+            cands.append((top, found_k, order))
+    if not cands:
+        return None
+    top, k, order = min(cands)
+    return nodes[order].metadata.name, k

@@ -1,0 +1,150 @@
+"""Objective tensorization: the extra operands the objective modes solve on.
+
+Shared by the full Tensorizer (ops/tensorize.py) and the incremental mirror
+(ops/incremental.py) — both hand this module a slot-indexed view of their
+node space and the placed-pod set, and get back the SAME tensor layout, so
+the kernel traces one program regardless of which tensorize path fed it.
+
+Arrays (absent entirely when the objective doesn't need them — the default
+program's input signature, and therefore its jit key and compiled HLO, is
+untouched):
+
+- ``pod_priority``  [P]        f32   preempt: pending-pod priorities
+- ``vict_prio``     [KV, N]    f32   preempt: priority of the k-th
+                                     lowest-priority victim candidate per
+                                     node slot (INF_PRIORITY padded)
+- ``vict_cum``      [6, KV+1, N] f32 preempt: cumulative resource relief of
+                                     evicting the k lowest-priority victims
+                                     (rows: cpu, mem MiB, gpu, pods,
+                                     nonzero-cpu, nonzero-mem MiB)
+- ``pod_gang``      [P]        i32   gang: gang slot per pod (null = GG-1)
+- ``gang_dom0``     [GG]       i32   gang: chosen topology domain carry
+                                     init (-1 = none yet)
+- ``gang_failed0``  [GG]       f32   gang: failed-flag carry init (0)
+- ``node_gang_dom`` [N]        i32   gang: topology-domain id per node slot
+                                     under the objective's topology key
+                                     (-1 = node lacks the label)
+
+Host-side decode info (never uploaded): per-slot victim order (the k-prefix
+the kernel's victim count indexes into) and gang names/members.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.objectives.config import (
+    INF_PRIORITY, ObjectiveConfig, pod_gang, pod_priority,
+)
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+class ObjectiveInfo:
+    """Host-side decode companion to the objective arrays."""
+
+    def __init__(self):
+        self.victim_order: List[List[str]] = []   # per node slot, ns/name
+        self.gang_names: List[str] = []           # gang slot -> name
+        self.gang_members: Dict[str, List[str]] = {}   # name -> pod keys
+        self.n_gangs: int = 0
+
+
+def build_objective_tensors(
+        objective: ObjectiveConfig,
+        pending: List[api.Pod],
+        Pp: int,
+        n_cap: int,
+        node_labels_of: Callable[[int], dict],
+        placed: Iterable[Tuple[api.Pod, int]],
+) -> Tuple[Dict[str, np.ndarray], ObjectiveInfo]:
+    """Build the mode-gated objective arrays.
+
+    `node_labels_of(slot)` returns the labels dict for a node slot (empty
+    for holes); `placed` yields (pod, slot) for every evictable placed pod
+    (callers exclude terminating pods — a pod already on its way out is not
+    a victim worth nominating).
+    """
+    arrays: Dict[str, np.ndarray] = {}
+    info = ObjectiveInfo()
+    P = len(pending)
+
+    if objective.preempt:
+        prio = np.zeros(Pp, np.float32)
+        for p, pod in enumerate(pending):
+            prio[p] = pod_priority(pod)
+        arrays["pod_priority"] = prio
+
+        # victim candidates per slot, sorted ascending (priority, pod key)
+        # — the deterministic order the kernel's k-prefix eviction and the
+        # oracle replay both index into
+        per_slot: Dict[int, list] = {}
+        for pod, slot in placed:
+            key = (f"{pod.metadata.namespace}/{pod.metadata.name}"
+                   if pod.metadata else "")
+            per_slot.setdefault(slot, []).append(
+                (pod_priority(pod), key, pod))
+        vmax = max((len(v) for v in per_slot.values()), default=0)
+        KV = _pow2(max(vmax, 1))
+        vict_prio = np.full((KV, n_cap), INF_PRIORITY, np.float32)
+        vict_cum = np.zeros((6, KV + 1, n_cap), np.float32)
+        info.victim_order = [[] for _ in range(n_cap)]
+        from kubernetes_tpu.ops.tensorize import _pod_req_vec
+        for slot, entries in per_slot.items():
+            entries.sort(key=lambda e: (e[0], e[1]))
+            info.victim_order[slot] = [k for _, k, _ in entries]
+            acc = np.zeros(6, np.float32)
+            for j, (pr, _key, pod) in enumerate(entries):
+                vict_prio[j, slot] = pr
+                rq, nz = _pod_req_vec(pod)
+                acc = acc + np.concatenate([rq, nz]).astype(np.float32)
+                vict_cum[:, j + 1, slot] = acc
+            # beyond the last victim the prefix stays flat (clipped gathers
+            # then read "no further relief")
+            for j in range(len(entries) + 1, KV + 1):
+                vict_cum[:, j, slot] = acc
+        arrays["vict_prio"] = vict_prio
+        arrays["vict_cum"] = vict_cum
+
+    if objective.gang:
+        gang_ids: Dict[str, int] = {}
+        for pod in pending:
+            g = pod_gang(pod)
+            if g is not None and g not in gang_ids:
+                gang_ids[g] = len(gang_ids)
+                info.gang_names.append(g)
+                info.gang_members[g] = []
+        info.n_gangs = len(gang_ids)
+        GG = _pow2(info.n_gangs + 1)      # last slot = the null gang
+        null = GG - 1
+        pg = np.full(Pp, null, np.int32)
+        for p, pod in enumerate(pending):
+            g = pod_gang(pod)
+            if g is not None:
+                pg[p] = gang_ids[g]
+                info.gang_members[g].append(
+                    f"{pod.metadata.namespace}/{pod.metadata.name}")
+        arrays["pod_gang"] = pg
+        arrays["gang_dom0"] = np.full(GG, -1, np.int32)
+        arrays["gang_failed0"] = np.zeros(GG, np.float32)
+
+        dom_ids: Dict[str, int] = {}
+        ngd = np.full(n_cap, -1, np.int32)
+        key = objective.gang_topology_key
+        for slot in range(n_cap):
+            val = (node_labels_of(slot) or {}).get(key)
+            if val:
+                if val not in dom_ids:
+                    dom_ids[val] = len(dom_ids)
+                ngd[slot] = dom_ids[val]
+        arrays["node_gang_dom"] = ngd
+
+    return arrays, info

@@ -63,6 +63,30 @@ def least_requested(pod: api.Pod, info: Dict[str, NodeInfo],
     return out
 
 
+def most_requested(pod: api.Pod, info: Dict[str, NodeInfo],
+                   nodes: List[api.Node]) -> Scores:
+    """MostRequested: _calculate_score inverted — fuller nodes score higher,
+    minimizing fragmentation across the cluster (the binpack objective's
+    Python reference; "Priority Matters", arxiv 2511.08373)."""
+    out = {}
+    for node in nodes:
+        ni = info.get(node.metadata.name) or NodeInfo(node)
+        cpu, mem = _pod_nonzero_totals(pod, ni)
+        alloc = ni.allocatable if ni.node else NodeInfo(node).allocatable
+        cpu_score = _calculate_inverted(cpu, alloc.milli_cpu)
+        mem_score = _calculate_inverted(mem, alloc.memory)
+        out[node.metadata.name] = (cpu_score + mem_score) // 2
+    return out
+
+
+def _calculate_inverted(requested: int, capacity: int) -> int:
+    """req*10/cap with integer truncation; 0 when over capacity or the
+    capacity is unknown — the exact mirror of the kernel's binpack term."""
+    if capacity == 0 or requested > capacity:
+        return 0
+    return (requested * MAX_PRIORITY) // capacity
+
+
 def balanced_resource_allocation(pod: api.Pod, info: Dict[str, NodeInfo],
                                  nodes: List[api.Node]) -> Scores:
     out = {}

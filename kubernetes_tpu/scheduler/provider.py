@@ -23,6 +23,13 @@ from kubernetes_tpu.api import types as api
 from kubernetes_tpu.scheduler import predicates as preds
 from kubernetes_tpu.scheduler import priorities as prios
 from kubernetes_tpu.scheduler.generic import PriorityConfig
+# the scheduling-objective registry rides the same provider boundary
+# (ROADMAP 5's pluggable-objective seam): objectives register by name,
+# providers/policies select them by name, unknown names raise KeyError
+from kubernetes_tpu.scheduler.objectives.config import (  # noqa: F401
+    ObjectiveConfig, get_objective, objective_names, register_objective,
+    resolve_objective,
+)
 
 
 @dataclass
@@ -59,9 +66,16 @@ def register_priority(name: str, weight: int, factory: Callable):
 
 
 def register_algorithm_provider(name: str, predicate_keys: List[str],
-                                priority_keys: List[str]):
+                                priority_keys: List[str],
+                                objective: Optional[str] = None):
+    """Register a provider; `objective` (optional) names a registered
+    scheduling objective the provider's batch scheduler solves under —
+    validated eagerly so a typo fails at registration, not at solve time."""
+    if objective is not None:
+        get_objective(objective)  # KeyError on unknown names
     _PROVIDERS[name] = {"predicates": list(predicate_keys),
-                        "priorities": list(priority_keys)}
+                        "priorities": list(priority_keys),
+                        "objective": objective}
     return name
 
 
@@ -140,6 +154,10 @@ register_priority(
 register_priority("ImageLocalityPriority", 1,
                   lambda a: prios.image_locality_priority)
 register_priority("EqualPriority", 1, lambda a: prios.equal_priority)
+# MostRequested: the binpack objective's sequential reference — registered
+# so the oracle (and the BatchScheduler's sequential fallback) can run the
+# same fragmentation-minimizing scoring the kernel's binpack mode traces
+register_priority("MostRequestedPriority", 1, lambda a: prios.most_requested)
 
 
 def _noop_predicate(pod, node_info):
@@ -160,11 +178,23 @@ DEFAULT_PROVIDER = register_algorithm_provider(
 
 # --- policy file (api/types.go:27-173) ---------------------------------------
 
+def policy_objective(policy: dict) -> Optional[ObjectiveConfig]:
+    """Resolve a policy dict's `objective` key (name of a registered
+    scheduling objective) to its config; None when the policy names none.
+    Unknown names raise KeyError — a policy typo must fail loudly, exactly
+    like an unknown predicate/priority name."""
+    name = policy.get("objective")
+    return get_objective(name) if name is not None else None
+
+
 def load_policy(policy: dict, args: PluginArgs):
     """Build (predicates, priorities, extender_configs) from a policy dict
     (the --policy-config-file JSON). Custom predicate arguments are limited
     to ServiceAffinity/LabelsPresence; custom priorities to
-    ServiceAntiAffinity/LabelPreference — exactly the reference's whitelist."""
+    ServiceAntiAffinity/LabelPreference — exactly the reference's whitelist.
+    An `objective` key is validated against the objective registry here
+    (consumed by the batch scheduler via policy_objective)."""
+    policy_objective(policy)  # validate eagerly: unknown names fail the load
     predicates: Dict[str, Callable] = {}
     for p in policy.get("predicates", []):
         name, argspec = p["name"], p.get("argument")

@@ -121,10 +121,30 @@ class BatchScheduler(Scheduler):
                  bug_cooldown: float = 300.0, clock=time.monotonic,
                  incremental: bool = True,
                  stage_deadlines: Optional[dict] = None,
-                 explain: Optional[bool] = None):
+                 explain: Optional[bool] = None,
+                 objective=None):
         super().__init__(factory, algorithm)
         self.batch_size = batch_size
         self.weights = weights or Weights()
+        # scheduling-objective mode (scheduler/objectives): a name or an
+        # ObjectiveConfig; None/default keeps the pre-objective kernel
+        # program bit-identical, KTPU_OBJECTIVE is the env seam
+        from kubernetes_tpu.scheduler.objectives.config import (
+            resolve_objective,
+        )
+        self.objective = resolve_objective(objective, env=True)
+        self._last_outcome = None
+        # gangs whose rejection was already counted: a still-pending gang
+        # is re-solved (and re-rejected) on every backoff retry, but it is
+        # ONE rejected gang, not one per solve
+        self._rejected_gangs_counted: set = set()
+        # preemptors with an outstanding nomination (pod key -> node):
+        # nothing reserves the freed capacity (no spec nominatedNodeName),
+        # so without this a still-unschedulable preemptor would evict a
+        # FRESH victim set on every backoff retry — an unbounded eviction
+        # storm. One eviction round per nomination; cleared when the
+        # preemptor binds.
+        self._nominated: dict = {}
         # per-predicate decision provenance from the solve (ISSUE 12): the
         # kernel emits survivor counts + score decompositions, decoded into
         # the DecisionLedger / FailedScheduling breakdowns. Default on;
@@ -145,7 +165,8 @@ class BatchScheduler(Scheduler):
         if incremental:
             from kubernetes_tpu.ops.incremental import IncrementalTensorizer
             self._inc = IncrementalTensorizer(factory.plugin_args,
-                                              pod_bucket=batch_size)
+                                              pod_bucket=batch_size,
+                                              objective=self.objective)
             factory.cache.add_listener(self._inc)
         self.kernel_batches = 0     # successful device batches
         self.kernel_pods = 0        # pods placed via the device path
@@ -225,7 +246,14 @@ class BatchScheduler(Scheduler):
                 self._bug_cooldown, traceback.format_exc())
 
     def _spawn_bind(self, pod, dest, t_start, did_assume):
-        self._bind_pool.submit(self._bind, pod, dest, t_start, did_assume)
+        self._nominated.pop(
+            f"{pod.metadata.namespace}/{pod.metadata.name}", None)
+        try:
+            self._bind_pool.submit(self._bind, pod, dest, t_start, did_assume)
+        except RuntimeError:
+            # stop() shut the pool down while this batch was mid-flight —
+            # finish the bind inline instead of dropping the placement
+            self._bind(pod, dest, t_start, did_assume)
 
     def _fallback_sequential(self, pods):
         """Schedule a drained batch through the sequential oracle — the one
@@ -243,6 +271,52 @@ class BatchScheduler(Scheduler):
         if first is None:
             return 0
         pods = [first] + self.f.pending.drain(self.batch_size - 1)
+        if self.objective is not None and self.objective.gang:
+            # all-or-nothing cannot survive a count-based batch slice: two
+            # solves each see a partial gang and commit (or reject) it
+            # independently, splitting one gang across topology domains.
+            # Pull the co-pending tail of any gang the drain cut at the
+            # boundary into THIS batch.
+            from kubernetes_tpu.scheduler.objectives.config import pod_gang
+            gangs = {pod_gang(p) for p in pods} - {None}
+            if gangs:
+                pods += self.f.pending.drain_where(
+                    lambda p: pod_gang(p) in gangs)
+            if len(pods) > self.batch_size:
+                # ...but the pull must not break the fixed pod-bucket
+                # shape (P > bucket pads to the NEXT power of two: a
+                # second XLA compile mid-churn + up to 2x padded solve) —
+                # give back whole trailing units until the batch fits.
+                # Only a single gang bigger than batch_size ever runs
+                # oversized: one padded solve beats never placing it.
+                units, by_gang = [], {}
+                for p in pods:
+                    g = pod_gang(p)
+                    if g is None:
+                        units.append([p])
+                    elif g in by_gang:
+                        by_gang[g].append(p)
+                    else:
+                        by_gang[g] = [p]
+                        units.append(by_gang[g])
+                pods, n, give_back = [], 0, []
+                for i, unit in enumerate(units):
+                    if not give_back and (
+                            i == 0 or n + len(unit) <= self.batch_size):
+                        pods.extend(unit)
+                        n += len(unit)
+                    else:
+                        # a true trailing cut: once one unit goes back,
+                        # everything after it does too — admitting a
+                        # later-arrived unit past an earlier give-back
+                        # would invert FIFO intake order
+                        give_back.extend(unit)
+                # back to the HEAD of the queue in original order, so the
+                # cut units lead the next drain instead of aging at the
+                # tail behind younger arrivals (requeue_front also keeps
+                # any newer informer copy over our stale drained object)
+                for p in reversed(give_back):
+                    self.f.pending.requeue_front(p)
         t_start = time.perf_counter()
         # one batch span; per-pod roots close their queue_wait stage here
         # and carry a link to the batch trace that solves them
@@ -324,21 +398,40 @@ class BatchScheduler(Scheduler):
         self._on_kernel_success()
         self.kernel_batches += 1
         records, self._last_explain = (self._last_explain or []), None
+        outcome, self._last_outcome = self._last_outcome, None
+        preempted, gang_of = self._apply_outcome(outcome)
         recmap = {}
         if records:
             from kubernetes_tpu.observability.explain import LEDGER
             for rec in records:
+                dec = preempted.get(rec.pod)
+                if dec is not None and rec.preemption is not None:
+                    # suppressed retries hand back the original eviction
+                    # record — the ledger must show it too, or /explainz
+                    # and the event would disagree
+                    rec.preemption = {"node": dec.node,
+                                      "victims": list(dec.victims)}
                 LEDGER.add(rec)
             recmap = {r.pod: r for r in records}
         for pod, dest in zip(pods, results):
             key = f"{pod.metadata.namespace}/{pod.metadata.name}"
             rec = recmap.get(key)
             if dest is None:
-                if rec is not None:
+                if key in preempted:
+                    from kubernetes_tpu.scheduler.objectives.decode import (
+                        PreemptionFitError,
+                    )
+                    err: FitError = PreemptionFitError(pod, preempted[key])
+                elif key in gang_of:
+                    from kubernetes_tpu.scheduler.objectives.decode import (
+                        GangFitError,
+                    )
+                    err = GangFitError(pod, gang_of[key])
+                elif rec is not None:
                     from kubernetes_tpu.observability.explain import (
                         KernelFitError,
                     )
-                    err: FitError = KernelFitError(pod, rec)
+                    err = KernelFitError(pod, rec)
                 else:
                     err = FitError(pod, {
                         "*": "kernel: no feasible node in batch"})
@@ -353,6 +446,74 @@ class BatchScheduler(Scheduler):
             self._assume_and_bind(pod, dest, t_start)
         return len(pods)
 
+    def _apply_outcome(self, outcome):
+        """Host side of the objective verdicts: evict preemption victims
+        through the apiserver (reference-style Preempted Event on each),
+        count gang placements, and hand back per-pod maps for the failure
+        routing above ({preemptor key: decision}, {member key: GangResult})."""
+        if outcome is None:
+            return {}, {}
+        preempted, gang_of = {}, {}
+        for dec in outcome.preemptions:
+            orig = self._nominated.get(dec.pod)
+            if orig is not None:
+                # this preemptor already got its eviction round on an
+                # earlier solve; the retry must not kill another victim
+                # set, and every surface (event/condition//explainz) must
+                # repeat the ORIGINAL eviction record — the fresh
+                # decision names victims that will never be deleted
+                preempted[dec.pod] = orig
+                METRICS.inc("scheduler_preemptions_total",
+                            reason="suppressed")
+                continue
+            preempted[dec.pod] = dec
+            while len(self._nominated) > 8192:
+                # bounded (preemptors deleted while pending leak their
+                # entry): shed the OLDEST nomination only — clearing all
+                # would re-arm every live preemptor's eviction at once
+                self._nominated.pop(next(iter(self._nominated)))
+            self._nominated[dec.pod] = dec
+            METRICS.observe("scheduler_preemption_victims",
+                            float(len(dec.victims)),
+                            buckets=(1, 2, 4, 8, 16, 32))
+            for vkey in dec.victims:
+                ns, _, name = vkey.partition("/")
+                victim = api.Pod(metadata=api.ObjectMeta(
+                    name=name, namespace=ns))
+                try:
+                    self.f.client.delete("pods", name, ns)
+                    METRICS.inc("scheduler_preemptions_total",
+                                reason="evicted")
+                    self.recorder.event(
+                        victim, "Normal", "Preempted",
+                        f"Preempted by {dec.pod} on node {dec.node}")
+                except Exception as e:
+                    # the nomination stands (the kernel already planned
+                    # around the relief); a failed evict must be visible,
+                    # not silently retried into a double-booked node
+                    log.warning("evicting %s for %s failed: %s",
+                                vkey, dec.pod, e)
+                    METRICS.inc("scheduler_preemptions_total",
+                                reason="evict-error")
+        for gr in outcome.gangs:
+            if gr.placed:
+                METRICS.inc("scheduler_gang_placements_total",
+                            outcome="placed")
+                # the name may be reused by a future gang — let it count
+                self._rejected_gangs_counted.discard(gr.name)
+            else:
+                if gr.name not in self._rejected_gangs_counted:
+                    if len(self._rejected_gangs_counted) > 8192:
+                        # bounded memory for gangs deleted while rejected;
+                        # worst case a long-rejected gang counts once more
+                        self._rejected_gangs_counted.clear()
+                    self._rejected_gangs_counted.add(gr.name)
+                    METRICS.inc("scheduler_gang_placements_total",
+                                outcome="rejected")
+                for m in gr.members:
+                    gang_of[m] = gr
+        return preempted, gang_of
+
     def _run_kernel(self, nodes: List[api.Node], existing: List[api.Pod],
                     pending: List[api.Pod]) -> List[Optional[str]]:
         """The staged, deadlined device pipeline: every stage (tensorize ->
@@ -361,7 +522,9 @@ class BatchScheduler(Scheduler):
         batch span."""
         batch_span = getattr(self, "_batch_span", None)
         explain = self.explain
+        objective = self.objective
         self._last_explain = None
+        self._last_outcome = None
         if self._inc is not None:
             inc = self._inc
             ret = run_stages(
@@ -373,8 +536,15 @@ class BatchScheduler(Scheduler):
             ret = run_stages(
                 lambda stage: tpu_batch(nodes, existing, pending,
                                         self.f.plugin_args, self.weights,
-                                        stage=stage, explain=explain),
+                                        stage=stage, explain=explain,
+                                        objective=objective),
                 deadlines=self.stage_deadlines, span=batch_span)
+        if objective is not None and isinstance(ret, tuple):
+            if explain:
+                results, self._last_explain, self._last_outcome = ret
+            else:
+                results, self._last_outcome = ret
+            return results
         if explain and isinstance(ret, tuple):
             results, self._last_explain = ret
             return results
@@ -388,7 +558,8 @@ class BatchScheduler(Scheduler):
         from kubernetes_tpu.ops.incremental import IncrementalTensorizer
         old = self._inc
         fresh = IncrementalTensorizer(self.f.plugin_args,
-                                      pod_bucket=self.batch_size)
+                                      pod_bucket=self.batch_size,
+                                      objective=self.objective)
         self.f.cache.remove_listener(old)
         self.f.cache.add_listener(fresh)
         self._inc = fresh
@@ -420,18 +591,38 @@ def create_batch_scheduler(factory: ConfigFactory,
                            weights: Optional[Weights] = None,
                            strict: bool = False,
                            stage_deadlines: Optional[dict] = None,
-                           explain: Optional[bool] = None
+                           explain: Optional[bool] = None,
+                           objective=None
                            ) -> BatchScheduler:
     """Build a BatchScheduler whose fallback algorithm is the oracle built
-    from the same provider (CreateFromProvider seam, factory.go:248-342)."""
+    from the same provider (CreateFromProvider seam, factory.go:248-342).
+
+    `objective` (name or ObjectiveConfig; default: the provider's
+    registered objective, then KTPU_OBJECTIVE) selects the kernel's solve
+    mode.  In binpack mode the sequential fallback gains the
+    MostRequestedPriority at the objective's weight, so a device outage
+    degrades to the SAME packing policy; preemption/gang semantics are
+    kernel-only — the fallback schedules those pods plainly."""
     from kubernetes_tpu.scheduler.generic import GenericScheduler
+    from kubernetes_tpu.scheduler.objectives.config import resolve_objective
     from kubernetes_tpu.scheduler.provider import (
         DEFAULT_PROVIDER, get_predicates, get_priorities, get_provider,
     )
     prov = get_provider(provider_name or DEFAULT_PROVIDER)
+    if objective is None:
+        objective = prov.get("objective")
+    obj_cfg = resolve_objective(objective, env=True)
     predicates = get_predicates(prov["predicates"], factory.plugin_args)
-    priorities = get_priorities(prov["priorities"], factory.plugin_args)
+    priority_keys = list(prov["priorities"])
+    prio_weights = None
+    if obj_cfg is not None and obj_cfg.binpack and obj_cfg.binpack_weight \
+            and "MostRequestedPriority" not in priority_keys:
+        priority_keys.append("MostRequestedPriority")
+        prio_weights = {"MostRequestedPriority": obj_cfg.binpack_weight}
+    priorities = get_priorities(priority_keys, factory.plugin_args,
+                                weights=prio_weights)
     algorithm = GenericScheduler(predicates, priorities)
     return BatchScheduler(factory, algorithm, batch_size=batch_size,
                           weights=weights, strict=strict,
-                          stage_deadlines=stage_deadlines, explain=explain)
+                          stage_deadlines=stage_deadlines, explain=explain,
+                          objective=obj_cfg)

@@ -276,4 +276,10 @@ HELP.update({
     "workqueue_depth": "Controller workqueue depth",
     "compile_cache_events_total":
         "Persistent XLA cache hit/miss/rejected/disabled, by fingerprint",
+    "scheduler_preemptions_total":
+        "Preemption victim evictions, by outcome (evicted/evict-error)",
+    "scheduler_preemption_victims":
+        "Victims per preemption nomination",
+    "scheduler_gang_placements_total":
+        "Gang scheduling verdicts (placed/rejected)",
 })

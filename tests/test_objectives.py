@@ -1,0 +1,874 @@
+"""Scheduling objectives subsystem (ISSUE 13): bin-packing, priority
+preemption, and gang scheduling as tensor solve modes.
+
+The acceptance anchor is oracle equivalence: on randomized fixtures the
+kernel's placements, victim sets, nominated nodes, gang verdicts, survivor
+rows, and score decompositions must match the node-by-node Python replay
+(scheduler/objectives/oracle.py) EXACTLY — and a disabled objective config
+must trace the bit-identical default program.  Plus the delivery surfaces:
+the provider-registry seam, incremental-mirror parity, live preemption
+eviction with Preempted Events and counters, and the gang_churn soak
+report blocks.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.observability.explain import oracle_breakdown
+from kubernetes_tpu.scheduler.batch import (
+    ListPodLister, ListServiceLister, make_plugin_args, tpu_batch,
+)
+from kubernetes_tpu.scheduler.objectives.config import (
+    GANG_LABEL, PRIORITY_ANNOTATION, ObjectiveConfig, gang_order,
+    get_objective, pod_gang, pod_priority,
+)
+from kubernetes_tpu.scheduler.objectives.oracle import oracle_objective
+
+
+def mk_node(name, cpu="4", mem="8Gi", pods="110", labels=None, taints=None):
+    labels = dict(labels or {})
+    labels.setdefault(api.LABEL_HOSTNAME, name)
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels),
+        spec=api.NodeSpec(taints=taints),
+        status=api.NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": pods},
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+
+def mk_pod(name, ns="default", cpu=None, mem="256Mi", labels=None, node="",
+           selector=None, priority=None, gang=None, host_ports=()):
+    labels = dict(labels or {})
+    ann = None
+    if priority is not None:
+        ann = {PRIORITY_ANNOTATION: str(priority)}
+    if gang is not None:
+        labels[GANG_LABEL] = gang
+    requests = {"memory": mem}
+    if cpu:
+        requests["cpu"] = cpu
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels,
+                                annotations=ann),
+        spec=api.PodSpec(
+            node_name=node, node_selector=selector,
+            containers=[api.Container(
+                name="c", image="pause",
+                ports=[api.ContainerPort(host_port=p, container_port=p)
+                       for p in host_ports],
+                resources=api.ResourceRequirements(requests=requests))]))
+
+
+def _records_equal(kr, orr):
+    assert kr.pod == orr.pod
+    assert kr.survivors == orr.survivors, (
+        f"{kr.pod}: survivors {kr.survivors} != oracle {orr.survivors}")
+    assert kr.node == orr.node, (kr.pod, kr.node, orr.node)
+    assert kr.preemption == orr.preemption, kr.pod
+    assert kr.gang == orr.gang, kr.pod
+    if kr.node is None:
+        return
+    assert kr.score == pytest.approx(orr.score, abs=1e-4), kr.pod
+    assert set(kr.components) == set(orr.components), kr.pod
+    for name in orr.components:
+        assert kr.components[name] == pytest.approx(
+            orr.components[name], abs=1e-4), (kr.pod, name)
+    assert kr.runner_up == orr.runner_up, kr.pod
+
+
+def _outcomes_equal(kout, oout):
+    assert [(p.pod, p.node, p.victims) for p in kout.preemptions] == \
+        [(p.pod, p.node, p.victims) for p in oout.preemptions]
+    assert [(g.name, g.placed, g.members) for g in kout.gangs] == \
+        [(g.name, g.placed, g.members) for g in oout.gangs]
+
+
+class TestGangOrder:
+    def test_members_contiguous_at_first_arrival(self):
+        pods = [mk_pod("a"), mk_pod("g1a", gang="g1"), mk_pod("b"),
+                mk_pod("g2a", gang="g2"), mk_pod("g1b", gang="g1"),
+                mk_pod("g2b", gang="g2"), mk_pod("c")]
+        ordered, perm = gang_order(pods)
+        names = [p.metadata.name for p in ordered]
+        assert names == ["a", "g1a", "g1b", "b", "g2a", "g2b", "c"]
+        # perm maps ordered[j] back to pods[perm[j]]
+        for j, i in enumerate(perm):
+            assert ordered[j] is pods[i]
+
+    def test_no_gangs_identity(self):
+        pods = [mk_pod(f"p{i}") for i in range(5)]
+        ordered, perm = gang_order(pods)
+        assert ordered == pods
+        assert perm == list(range(5))
+
+
+class TestObjectiveInputs:
+    def test_priority_annotation(self):
+        assert pod_priority(mk_pod("p", priority=7)) == 7.0
+        assert pod_priority(mk_pod("p")) == 0.0
+        bad = mk_pod("p")
+        bad.metadata.annotations = {PRIORITY_ANNOTATION: "not-a-number"}
+        assert pod_priority(bad) == 0.0  # malformed must not unschedule
+
+    def test_gang_label(self):
+        # namespace-qualified: two teams independently labelling their
+        # jobs gang=train must not fuse into one all-or-nothing unit
+        assert pod_gang(mk_pod("p", gang="j1")) == "default/j1"
+        assert pod_gang(mk_pod("p", ns="teamB", gang="j1")) == "teamB/j1"
+        assert pod_gang(mk_pod("p")) is None
+
+
+class TestKernelOracleParity:
+    """The acceptance anchor: kernel objective output == Python replay."""
+
+    def _random_cluster(self, seed, n_nodes=16, small_nodes=True):
+        rng = random.Random(seed)
+        zones = ["us-a", "us-b", "us-c"]
+        nodes = []
+        for i in range(n_nodes):
+            labels = {api.LABEL_HOSTNAME: f"n{i:02d}",
+                      api.LABEL_ZONE: rng.choice(zones)}
+            if rng.random() < 0.3:
+                labels["disk"] = "ssd"
+            cpu = rng.choice(["1", "2"]) if small_nodes else "4"
+            nodes.append(mk_node(f"n{i:02d}", cpu=cpu,
+                                 pods=str(rng.choice([4, 110])),
+                                 labels=labels))
+        existing = []
+        for i in range(10):
+            existing.append(mk_pod(
+                f"e{i:02d}", cpu=f"{rng.choice([300, 500, 700])}m",
+                mem="256Mi", labels={"app": rng.choice(["web", "db"])},
+                priority=rng.choice([0, 1, 2]),
+                node=rng.choice(nodes).metadata.name))
+        return rng, zones, nodes, existing
+
+    def _args(self, nodes, existing):
+        def build():
+            return make_plugin_args(
+                nodes, pod_lister=ListPodLister(list(existing)))
+        return build
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_binpack_parity(self, seed):
+        rng, _zones, nodes, existing = self._random_cluster(
+            seed, small_nodes=False)
+        pending = [mk_pod(f"p{i:02d}", cpu=f"{rng.choice([100, 400, 900])}m")
+                   for i in range(24)]
+        pending.append(mk_pod("huge", cpu="64"))
+        obj = get_objective("binpack")
+        args = self._args(nodes, existing)
+        names, recs, outcome = tpu_batch(nodes, existing, pending, args(),
+                                         objective=obj, explain=True)
+        res = oracle_objective(nodes, existing, pending, args(), obj)
+        assert names == res.names
+        _outcomes_equal(outcome, res.outcome)
+        assert any("binpack" in r.components for r in recs if r.node)
+        for kr, orr in zip(recs, res.records):
+            _records_equal(kr, orr)
+
+    @pytest.mark.parametrize("seed", [3, 4, 5])
+    def test_preempt_parity(self, seed):
+        rng, _zones, nodes, existing = self._random_cluster(seed)
+        pending = []
+        for i in range(16):
+            prio = rng.choice([0, 0, 3, 5, 9])
+            pending.append(mk_pod(
+                f"p{i:02d}", cpu=f"{rng.choice([200, 600, 900, 1500])}m",
+                priority=prio,
+                selector={"disk": "ssd"} if rng.random() < 0.15 else None))
+        obj = get_objective("preempt")
+        args = self._args(nodes, existing)
+        names, recs, outcome = tpu_batch(nodes, existing, pending, args(),
+                                         objective=obj, explain=True)
+        res = oracle_objective(nodes, existing, pending, args(), obj)
+        assert names == res.names
+        _outcomes_equal(outcome, res.outcome)
+        for kr, orr in zip(recs, res.records):
+            _records_equal(kr, orr)
+
+    @pytest.mark.parametrize("seed", [6, 7, 8])
+    def test_gang_parity(self, seed):
+        rng, zones, nodes, existing = self._random_cluster(seed)
+        pending = []
+        for i in range(6):
+            size = rng.choice([2, 3, 4])
+            for j in range(size):
+                pending.append(mk_pod(
+                    f"g{i}m{j}", cpu=f"{rng.choice([400, 700, 900])}m",
+                    gang=f"job{i}"))
+        for i in range(6):
+            pending.append(mk_pod(f"s{i}", cpu="300m"))
+        rng.shuffle(pending)
+        obj = get_objective("gang")
+        args = self._args(nodes, existing)
+        names, recs, outcome = tpu_batch(nodes, existing, pending, args(),
+                                         objective=obj, explain=True)
+        ordered, perm = gang_order(pending)
+        res = oracle_objective(nodes, existing, ordered, args(), obj)
+        from kubernetes_tpu.ops.kernel import unpermute_result
+        assert names == unpermute_result(res.names, perm)
+        _outcomes_equal(outcome, res.outcome)
+        for kr, orr in zip(recs, res.records):
+            _records_equal(kr, orr)
+        # all-or-nothing + topology: every placed gang sits in ONE zone
+        zone_of = {n.metadata.name: (n.metadata.labels or {})[api.LABEL_ZONE]
+                   for n in nodes}
+        by_name = dict(zip([f"{p.metadata.namespace}/{p.metadata.name}"
+                            for p in pending], names))
+        for gr in outcome.gangs:
+            member_nodes = [by_name[m] for m in gr.members]
+            if gr.placed:
+                assert all(member_nodes)
+                assert len({zone_of[n] for n in member_nodes}) == 1, gr.name
+            else:
+                assert member_nodes == [None] * len(gr.members), gr.name
+
+    @pytest.mark.parametrize("seed", [9, 10])
+    def test_gang_preempt_combined_parity(self, seed):
+        rng, _zones, nodes, existing = self._random_cluster(seed)
+        pending = []
+        for i in range(4):
+            for j in range(rng.choice([2, 3])):
+                pending.append(mk_pod(f"g{i}m{j}", cpu="600m",
+                                      gang=f"job{i}"))
+        for i in range(5):
+            pending.append(mk_pod(f"hi{i}", cpu="900m",
+                                  priority=rng.choice([5, 9])))
+        obj = get_objective("gang_preempt")
+        args = self._args(nodes, existing)
+        names, recs, outcome = tpu_batch(nodes, existing, pending, args(),
+                                         objective=obj, explain=True)
+        ordered, perm = gang_order(pending)
+        res = oracle_objective(nodes, existing, ordered, args(), obj)
+        from kubernetes_tpu.ops.kernel import unpermute_result
+        assert names == unpermute_result(res.names, perm)
+        _outcomes_equal(outcome, res.outcome)
+        for kr, orr in zip(recs, res.records):
+            _records_equal(kr, orr)
+
+    def test_oracle_breakdown_delegates(self):
+        """explain.oracle_breakdown(objective=...) is the documented entry
+        to the objective replay (ROADMAP item 3's per-mode oracle)."""
+        _rng, _zones, nodes, existing = self._random_cluster(11)
+        pending = [mk_pod("p0", cpu="300m"), mk_pod("p1", cpu="64")]
+        obj = get_objective("binpack")
+        args = self._args(nodes, existing)
+        names, recs, _outcome = tpu_batch(nodes, existing, pending, args(),
+                                          objective=obj, explain=True)
+        orecs = oracle_breakdown(nodes, existing, pending, args(), names,
+                                 objective=obj)
+        for kr, orr in zip(recs, orecs):
+            _records_equal(kr, orr)
+
+    def test_seeded_preemption_exact_victims(self):
+        """Hand-checked nomination: lowest (victim priority, victim count,
+        node order) wins, equal-or-higher priority never preempted."""
+        nodes = [mk_node("n0", cpu="1", pods="8"),
+                 mk_node("n1", cpu="1", pods="8"),
+                 mk_node("n2", cpu="1", pods="8")]
+        existing = [
+            # n0: one high-priority victim candidate -> protected
+            mk_pod("v-hi", cpu="900m", node="n0", priority=9),
+            # n1: two low victims (300m each) -> needs BOTH for an 800m pod
+            mk_pod("v-a", cpu="450m", node="n1", priority=1),
+            mk_pod("v-b", cpu="450m", node="n1", priority=2),
+            # n2: one mid victim frees enough alone -> fewer victims, but
+            # its priority (3) is HIGHER than n1's top victim (2): the
+            # lexicographic order prefers n1
+            mk_pod("v-c", cpu="900m", node="n2", priority=3),
+        ]
+        pending = [mk_pod("hi", cpu="800m", priority=5)]
+        obj = get_objective("preempt")
+        args = make_plugin_args(nodes,
+                                pod_lister=ListPodLister(list(existing)))
+        names, outcome = tpu_batch(nodes, existing, pending, args,
+                                   objective=obj)
+        assert names == [None]
+        assert len(outcome.preemptions) == 1
+        dec = outcome.preemptions[0]
+        assert dec.node == "n1"
+        assert dec.victims == ["default/v-a", "default/v-b"]
+
+    def test_never_preempts_equal_or_higher(self):
+        nodes = [mk_node("n0", cpu="1", pods="8")]
+        existing = [mk_pod("peer", cpu="900m", node="n0", priority=5)]
+        pending = [mk_pod("hi", cpu="800m", priority=5)]
+        args = make_plugin_args(nodes,
+                                pod_lister=ListPodLister(list(existing)))
+        names, outcome = tpu_batch(nodes, existing, pending, args,
+                                   objective=get_objective("preempt"))
+        assert names == [None]
+        assert outcome.preemptions == []
+
+    def test_disabled_objective_bit_identical(self):
+        """A disabled config selects the EXACT default program: identical
+        lowered HLO text, identical assignments, no extra arrays."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.fixtures import feature_batch
+        from kubernetes_tpu.ops.kernel import (
+            Weights, _schedule_jit, features_of,
+        )
+        from kubernetes_tpu.ops.tensorize import Tensorizer
+
+        ct = feature_batch(n_nodes=48, n_pods=24, with_existing=True)
+        feats, w = features_of(ct), Weights()
+        arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
+        disabled = ObjectiveConfig()
+        low_none = _schedule_jit.lower(
+            arrays, ct.n_zones, w, feats, False, None).as_text()
+        low_off = _schedule_jit.lower(
+            arrays, ct.n_zones, w, feats, False, disabled).as_text()
+        assert low_none == low_off
+        out_a = np.asarray(_schedule_jit(arrays, ct.n_zones, w, feats))
+        out_b = np.asarray(_schedule_jit(arrays, ct.n_zones, w, feats,
+                                         False, disabled))
+        assert np.array_equal(out_a, out_b)
+        assert Tensorizer(objective=disabled).objective is None
+
+    def test_explain_surfaces_for_objectives(self):
+        """Preemption reason string agrees with the FitError message; a
+        rejected gang member's eliminations carry the GangTopology row."""
+        from kubernetes_tpu.observability.explain import format_reason
+        from kubernetes_tpu.scheduler.objectives.decode import (
+            PreemptionFitError, preemption_message,
+        )
+
+        nodes = [mk_node("n0", cpu="1", pods="8",
+                         labels={api.LABEL_ZONE: "za"}),
+                 mk_node("n1", cpu="1", pods="8")]  # no zone label
+        existing = [mk_pod("low", cpu="900m", node="n0", priority=0),
+                    mk_pod("low1", cpu="900m", node="n1", priority=0)]
+        pending = [mk_pod("hi", cpu="800m", priority=9),
+                   mk_pod("gm0", cpu="100m", gang="j"),
+                   mk_pod("gm1", cpu="2", gang="j")]
+        args = make_plugin_args(nodes,
+                                pod_lister=ListPodLister(list(existing)))
+        names, recs, outcome = tpu_batch(
+            nodes, existing, pending, args,
+            objective=get_objective("gang_preempt"), explain=True)
+        by_pod = {r.pod: r for r in recs}
+        hi = by_pod["default/hi"]
+        assert hi.preemption is not None
+        assert format_reason(hi) == preemption_message(
+            hi.preemption["node"], hi.preemption["victims"])
+        err = PreemptionFitError(pending[0], outcome.preemptions[0])
+        assert str(err) == format_reason(hi)
+        # gm1 can never fit (2 cpu on 1-cpu nodes): the gang is rejected,
+        # and gm0's decision shows the gang verdict; the n1 node (no zone
+        # label) is eliminated on the GangTopology row for gang members
+        gm0 = by_pod["default/gm0"]
+        assert gm0.gang == {"name": "default/j", "outcome": "rejected"}
+        assert gm0.node is None
+        assert "GangTopology" in gm0.eliminations()
+
+
+class TestIncrementalParity:
+    """The incremental mirror must solve objectives identically to the
+    full Tensorizer (same arrays contract, same decode)."""
+
+    @pytest.mark.parametrize("objective", ["binpack", "gang_preempt"])
+    def test_full_vs_incremental(self, objective):
+        from kubernetes_tpu.ops.incremental import IncrementalTensorizer
+
+        rng = random.Random(42)
+        zones = ["za", "zb"]
+        nodes = [mk_node(f"n{i}", cpu="2", pods="8",
+                         labels={api.LABEL_ZONE: zones[i % 2]})
+                 for i in range(8)]
+        existing = [mk_pod(f"e{i}", cpu="700m", node=f"n{i % 8}",
+                           priority=i % 3) for i in range(8)]
+        pending = []
+        for i in range(3):
+            for j in range(2):
+                pending.append(mk_pod(f"g{i}m{j}", cpu="600m",
+                                      gang=f"job{i}"))
+        pending += [mk_pod(f"hi{i}", cpu="1800m", priority=9)
+                    for i in range(2)]
+        rng.shuffle(pending)
+        obj = get_objective(objective)
+
+        def args():
+            return make_plugin_args(
+                nodes, pod_lister=ListPodLister(list(existing)))
+
+        full = tpu_batch(nodes, existing, pending, args(), objective=obj,
+                         explain=True)
+        inc = IncrementalTensorizer(args(), objective=obj)
+        for n in nodes:
+            inc.node_added(n)
+        for p in existing:
+            inc.pod_added(p)
+        incr = inc.schedule(pending, explain=True)
+        assert full[0] == incr[0]
+        _outcomes_equal(full[2], incr[2])
+        for kr, ir in zip(full[1], incr[1]):
+            _records_equal(kr, ir)
+
+
+class TestLiveObjectivePipeline:
+    """BatchScheduler under gang_preempt against a live apiserver: victim
+    eviction through the API, Preempted Events, objective counters, and
+    the nominated node on the preemptor's failure surfaces."""
+
+    @pytest.fixture()
+    def cluster(self):
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client import RESTClient
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+        server = APIServer().start()
+        client = RESTClient.for_server(server, user_agent="objectives-test")
+        for i in range(3):
+            client.create("nodes", mk_node(
+                f"n{i}", cpu="1", mem="4Gi", pods="8",
+                labels={api.LABEL_HOSTNAME: f"n{i}",
+                        api.LABEL_ZONE: f"z{i % 2}"}))
+        factory = ConfigFactory(client)
+        factory.run(timeout=30)
+        sched = factory.create_batch_from_provider(
+            batch_size=16, objective="gang_preempt", strict=True).run()
+        try:
+            yield client, sched
+        finally:
+            sched.stop()
+            factory.stop()
+            server.stop()
+
+    def test_gang_then_preemption_live(self, cluster):
+        from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+        client, sched = cluster
+        base = dict(METRICS.counter_series("scheduler_preemptions_total"))
+
+        for i in range(2):
+            client.create("pods", mk_pod(f"tr{i}", cpu="300m", mem="64Mi",
+                                         gang="job1"))
+        for i in range(3):
+            client.create("pods", mk_pod(f"low{i}", cpu="600m", mem="64Mi",
+                                         priority=1))
+        deadline = time.monotonic() + 30
+        bound = {}
+        while time.monotonic() < deadline:
+            pods, _ = client.list("pods", "default")
+            bound = {p.metadata.name: p.spec.node_name for p in pods
+                     if p.spec and p.spec.node_name}
+            if len(bound) >= 5:
+                break
+            time.sleep(0.05)
+        assert bound.get("tr0") and bound.get("tr1"), bound
+
+        client.create("pods", mk_pod("hi", cpu="600m", mem="64Mi",
+                                     priority=10))
+        deadline = time.monotonic() + 30
+        hi_node, evicted, pre_ev, fs_ev = None, False, [], []
+        while time.monotonic() < deadline:
+            pods, _ = client.list("pods", "default")
+            by = {p.metadata.name: p for p in pods}
+            hi_node = (by["hi"].spec.node_name
+                       if "hi" in by and by["hi"].spec else None)
+            evicted = any(n not in by for n in ("low0", "low1", "low2"))
+            evs, _ = client.list("events", "default")
+            pre_ev = [e for e in evs if e.reason == "Preempted"]
+            fs_ev = [e for e in evs if e.reason == "FailedScheduling"
+                     and "nominated node" in (e.message or "")]
+            if hi_node and evicted and pre_ev and fs_ev:
+                break
+            time.sleep(0.05)
+        assert hi_node and evicted, (hi_node, evicted)
+        assert pre_ev, "no Preempted event on the victim"
+        assert fs_ev, "no nominated-node FailedScheduling event"
+        assert "Preempted by default/hi" in pre_ev[0].message
+
+        after = METRICS.counter_series("scheduler_preemptions_total")
+        key = (("reason", "evicted"),)
+        assert after.get(key, 0.0) > base.get(key, 0.0)
+        gangs = METRICS.counter_series("scheduler_gang_placements_total")
+        assert gangs.get((("outcome", "placed"),), 0.0) >= 1.0
+
+
+class TestGangChurnSoak:
+    def test_gang_churn_report_blocks(self):
+        """A tiny gang_churn soak emits the objective report blocks
+        (preemptions / gangs_placed / gangs_rejected) per round and in the
+        summary, and places at least one gang (check_soak.py's schema)."""
+        from kubernetes_tpu.observability.soak import SoakConfig, run_soak
+
+        # duration must outlast the gang_preempt program's cold compile
+        # (a few seconds on a loaded CPU runner) or the steady-state
+        # window legitimately sees zero binds and the schema check balks
+        cfg = SoakConfig(num_nodes=6, create_rate=24, duration_seconds=8,
+                         scrape_period=1, batch_size=32,
+                         scenario="gang_churn", gang_size=3,
+                         preempt_every=4, drain_timeout=15)
+        report = run_soak(cfg)
+        assert not report.get("wedged"), report.get("error")
+        assert report["config"]["scenario"] == "gang_churn"
+        assert report["config"]["objective"] == "gang_preempt"
+        assert report["gangs_placed"] > 0
+        assert "gangs_rejected" in report
+        assert isinstance(report["preemptions"], dict)
+        for rnd in report["rounds"]:
+            for key in ("preemptions", "gangs_placed", "gangs_rejected"):
+                assert key in rnd, (key, rnd)
+
+        import json
+        import sys
+
+        sys.path.insert(0, "tools")
+        try:
+            import check_soak
+        finally:
+            sys.path.pop(0)
+        doc = {"metric": "pods_scheduled_per_sec x", "value": 1.0,
+               "unit": "pods/s", "vs_baseline": 1.0,
+               "wedged": bool(report.get("wedged")), "detail": report}
+        errs = check_soak.check(json.loads(json.dumps(doc)),
+                                expect_wedged=False)
+        assert not errs, errs
+
+
+class TestGangBatchIntake:
+    """Count-based batch draining must never split a co-pending gang: the
+    intake pulls the queued tail of any gang the batch_size slice cut (the
+    all-or-nothing contract is per solve, so two solves each seeing half a
+    gang would commit or reject it independently)."""
+
+    def test_fifo_drain_where(self):
+        from kubernetes_tpu.client.cache import FIFO
+
+        q = FIFO()
+        for i in range(6):
+            q.add(mk_pod(f"p{i}", gang="g" if i % 2 else None))
+        got = q.drain_where(
+            lambda p: (p.metadata.labels or {}).get(GANG_LABEL) == "g")
+        assert [p.metadata.name for p in got] == ["p1", "p3", "p5"]
+        assert len(q) == 3  # non-matching pods stay queued, order kept
+        assert [p.metadata.name for p in q.drain(10)] == ["p0", "p2", "p4"]
+
+    def test_gang_straddling_batch_boundary(self):
+        """batch_size=2 with [solo, g0, g1, g2] pending: the drain slice
+        ends inside the gang. The intake gives the whole gang back (it
+        would overshoot the pod bucket behind the solo), then solves it
+        intact — oversized, since a gang larger than batch_size can only
+        ever run as the head of its own batch — in the NEXT call. It is
+        never split across solves."""
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client import RESTClient
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+        server = APIServer().start()
+        client = RESTClient.for_server(server, user_agent="objectives-test")
+        try:
+            for i in range(4):
+                client.create("nodes", mk_node(
+                    f"n{i}", cpu="2", mem="4Gi", pods="8",
+                    labels={api.LABEL_HOSTNAME: f"n{i}",
+                            api.LABEL_ZONE: f"z{i % 2}"}))
+            factory = ConfigFactory(client)
+            factory.run(timeout=30)
+            try:
+                sched = factory.create_batch_from_provider(
+                    batch_size=2, objective="gang", strict=True)
+                client.create("pods", mk_pod("solo", cpu="100m", mem="64Mi"))
+                for j in range(3):
+                    client.create("pods", mk_pod(f"g{j}", cpu="300m",
+                                                 mem="64Mi", gang="jobA"))
+                deadline = time.monotonic() + 20
+                while (len(factory.pending) < 4
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                assert len(factory.pending) == 4
+                # solo alone (the gang went back whole), then the gang
+                # intact as its own oversized batch
+                assert sched.schedule_batch_once(timeout=5) == 1
+                n = sched.schedule_batch_once(timeout=5)
+                assert n == 3, f"gang split at the boundary: got {n} pods"
+                deadline = time.monotonic() + 20
+                bound = {}
+                while time.monotonic() < deadline:
+                    pods, _ = client.list("pods", "default")
+                    bound = {p.metadata.name: p.spec.node_name for p in pods
+                             if p.spec and p.spec.node_name}
+                    if len(bound) == 4:
+                        break
+                    time.sleep(0.05)
+                assert len(bound) == 4, bound
+                zone = {f"n{i}": f"z{i % 2}" for i in range(4)}
+                gz = {zone[bound[f"g{j}"]] for j in range(3)}
+                assert len(gz) == 1, f"gang split across zones: {bound}"
+            finally:
+                factory.stop()
+        finally:
+            server.stop()
+
+    def test_gang_tail_pull_keeps_bucket_shape(self):
+        """Pulling a cut gang's tail must not overshoot batch_size (the
+        incremental mirror's pod bucket): whole trailing units are given
+        back to the queue, so the first solve handles gang A intact and
+        gang B arrives whole in the next batch."""
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client import RESTClient
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+        server = APIServer().start()
+        client = RESTClient.for_server(server, user_agent="objectives-test")
+        try:
+            for i in range(4):
+                client.create("nodes", mk_node(
+                    f"n{i}", cpu="4", mem="8Gi", pods="16",
+                    labels={api.LABEL_HOSTNAME: f"n{i}",
+                            api.LABEL_ZONE: f"z{i % 2}"}))
+            factory = ConfigFactory(client)
+            factory.run(timeout=30)
+            try:
+                sched = factory.create_batch_from_provider(
+                    batch_size=4, objective="gang", strict=True)
+                for j in range(3):
+                    client.create("pods", mk_pod(f"a{j}", cpu="200m",
+                                                 mem="64Mi", gang="jobA"))
+                for j in range(3):
+                    client.create("pods", mk_pod(f"b{j}", cpu="200m",
+                                                 mem="64Mi", gang="jobB"))
+                deadline = time.monotonic() + 20
+                while (len(factory.pending) < 6
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                assert len(factory.pending) == 6
+                # first solve: jobA complete (tail pulled), jobB given
+                # back whole — P stays at the pod bucket
+                assert sched.schedule_batch_once(timeout=5) == 3
+                assert len(factory.pending) == 3
+                # second solve: jobB arrives intact
+                assert sched.schedule_batch_once(timeout=5) == 3
+                deadline = time.monotonic() + 20
+                bound = {}
+                while time.monotonic() < deadline:
+                    pods, _ = client.list("pods", "default")
+                    bound = {p.metadata.name: p.spec.node_name for p in pods
+                             if p.spec and p.spec.node_name}
+                    if len(bound) == 6:
+                        break
+                    time.sleep(0.05)
+                assert len(bound) == 6, bound
+            finally:
+                factory.stop()
+        finally:
+            server.stop()
+
+    def test_rejected_gang_counted_once_across_retries(self):
+        """A still-pending gang is re-solved on every backoff retry; the
+        rejected counter must move once per gang, not once per solve, and
+        count again after an intervening placement (name reuse)."""
+        from kubernetes_tpu.scheduler.objectives.decode import (
+            GangResult, ObjectiveOutcome,
+        )
+        from kubernetes_tpu.scheduler.tpu import BatchScheduler
+        from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+        sched = BatchScheduler.__new__(BatchScheduler)
+        sched._rejected_gangs_counted = set()
+        key = (("outcome", "rejected"),)
+        pkey = (("outcome", "placed"),)
+
+        def series():
+            s = METRICS.counter_series("scheduler_gang_placements_total")
+            return s.get(key, 0.0), s.get(pkey, 0.0)
+
+        rej0, pl0 = series()
+        rejected = ObjectiveOutcome(objective="gang", gangs=[
+            GangResult(name="jobR", members=["default/a"], placed=False)])
+        for _ in range(3):  # three retry solves, one rejection
+            sched._apply_outcome(rejected)
+        assert series()[0] == rej0 + 1
+
+        placed = ObjectiveOutcome(objective="gang", gangs=[
+            GangResult(name="jobR", members=["default/a"], placed=True)])
+        sched._apply_outcome(placed)
+        assert series()[1] == pl0 + 1
+        sched._apply_outcome(rejected)  # a NEW gang reusing the name
+        assert series()[0] == rej0 + 2
+
+    def test_fifo_requeue_front(self):
+        from kubernetes_tpu.client.cache import FIFO
+
+        q = FIFO()
+        for i in range(3):
+            q.add(mk_pod(f"p{i}"))
+        taken = q.pop()  # p0
+        q.requeue_front(taken)
+        # a newer informer copy wins over the stale give-back, but the
+        # position still moves to the head
+        newer = mk_pod("p1", cpu="900m")
+        p1 = [p for p in q.drain(10) if p.metadata.name == "p1"][0]
+        for p in reversed([taken, newer]):
+            q.add(p)
+        q.add(mk_pod("p9"))
+        q.requeue_front(mk_pod("p1"))  # stale copy of p1
+        head = q.pop()
+        assert head.metadata.name == "p1"
+        req = head.spec.containers[0].resources.requests
+        assert req.get("cpu") == "900m", "stale give-back clobbered newer copy"
+
+    def test_cross_namespace_gangs_are_distinct_units(self):
+        """gang=train in two namespaces: one team's infeasible member must
+        not nullify the other team's placements (kernel and oracle agree)."""
+        nodes = [mk_node(f"n{i}", cpu="2",
+                         labels={api.LABEL_ZONE: f"z{i % 2}"})
+                 for i in range(4)]
+        pending = [
+            mk_pod("w0", ns="teamA", cpu="300m", gang="train"),
+            mk_pod("w1", ns="teamA", cpu="300m", gang="train"),
+            # teamB's second member can never fit -> teamB rejected
+            mk_pod("w0", ns="teamB", cpu="300m", gang="train"),
+            mk_pod("w1", ns="teamB", cpu="64", gang="train"),
+        ]
+        obj = get_objective("gang")
+        args = make_plugin_args(nodes, pod_lister=ListPodLister([]))
+        names, _recs, outcome = tpu_batch(nodes, [], pending, args,
+                                          objective=obj, explain=True)
+        res = oracle_objective(nodes, [], gang_order(pending)[0], args, obj)
+        _outcomes_equal(outcome, res.outcome)
+        by_gang = {g.name: g for g in outcome.gangs}
+        assert by_gang["teamA/train"].placed
+        assert not by_gang["teamB/train"].placed
+        by_name = dict(zip([f"{p.metadata.namespace}/{p.metadata.name}"
+                            for p in pending], names))
+        assert by_name["teamA/w0"] and by_name["teamA/w1"]
+        assert by_name["teamB/w0"] is None and by_name["teamB/w1"] is None
+
+    def test_preemption_eviction_suppressed_until_bind(self):
+        """A still-unschedulable preemptor gets ONE eviction round per
+        nomination — backoff retries must not kill a fresh victim set each
+        solve — and the guard clears when the preemptor binds."""
+        from kubernetes_tpu.scheduler.objectives.decode import (
+            ObjectiveOutcome, PreemptionDecision,
+        )
+        from kubernetes_tpu.scheduler.tpu import BatchScheduler
+        from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+        deletes = []
+
+        class StubClient:
+            def delete(self, kind, name, ns):
+                deletes.append(f"{ns}/{name}")
+
+        class StubRecorder:
+            def event(self, *a, **k):
+                pass
+
+        class StubF:
+            client = StubClient()
+
+        sched = BatchScheduler.__new__(BatchScheduler)
+        sched._nominated = {}
+        sched._rejected_gangs_counted = set()
+        sched.f = StubF()
+        sched.recorder = StubRecorder()
+
+        def outcome(victims):
+            return ObjectiveOutcome(objective="preempt", preemptions=[
+                PreemptionDecision(pod="default/hi", node="n0",
+                                   victims=list(victims))])
+
+        skey = (("reason", "suppressed"),)
+        base = dict(METRICS.counter_series("scheduler_preemptions_total"))
+        sched._apply_outcome(outcome(["default/low0"]))
+        assert deletes == ["default/low0"]
+        # retry solves nominate again (different victims even) — no kills,
+        # and the surfaced decision repeats the ORIGINAL eviction record
+        # (the fresh one names victims that will never be deleted)
+        p2, _ = sched._apply_outcome(outcome(["default/low1"]))
+        sched._apply_outcome(outcome(["default/low2"]))
+        assert deletes == ["default/low0"]
+        assert p2["default/hi"].victims == ["default/low0"]
+        after = METRICS.counter_series("scheduler_preemptions_total")
+        assert after.get(skey, 0.0) == base.get(skey, 0.0) + 2
+        # bind clears the guard; a later repeat preemption evicts again
+        sched._nominated.pop("default/hi", None)
+        sched._apply_outcome(outcome(["default/low3"]))
+        assert deletes == ["default/low0", "default/low3"]
+
+    def test_gang_churner_never_reuses_names(self):
+        """A mid-burst create failure must not shift the next burst onto
+        already-created names (AlreadyExists would leave that gang short a
+        member forever)."""
+        from kubernetes_tpu.observability.soak import _GangChurner
+
+        attempted = []
+
+        class FlakyClient:
+            def __init__(self):
+                self.calls = 0
+
+            def create(self, kind, obj):
+                self.calls += 1
+                attempted.append(obj.metadata.name)
+                if self.calls == 2:  # second member of the first burst
+                    raise RuntimeError("transient apiserver error")
+
+            def delete(self, kind, name, ns):
+                pass
+
+        ch = _GangChurner(FlakyClient(), rate=1000.0, cap=10_000,
+                          gang_size=3, preempt_every=100)
+        t = 0.0
+        ch.tick(t)
+        for _ in range(3):
+            t += 0.01
+            ch.tick(t)
+            if len(attempted) >= 9:
+                break
+        assert len(attempted) >= 9
+        assert len(set(attempted)) == len(attempted), (
+            f"reused pod names: {attempted}")
+        assert ch.create_errors == 1
+
+    def test_gang_churner_departs_whole_gangs(self):
+        """The cap trim removes arrival units (whole gangs / whole preempt
+        bursts), never a gang suffix — a 1-pod preempt burst must not put
+        the pod-at-a-time trim out of gang alignment."""
+        from kubernetes_tpu.observability.soak import _GangChurner
+
+        created, deleted = [], []
+
+        class StubClient:
+            def create(self, kind, obj):
+                created.append(obj.metadata.name)
+
+            def delete(self, kind, name, ns):
+                deleted.append(name)
+
+        ch = _GangChurner(StubClient(), rate=1000.0, cap=5,
+                          gang_size=3, preempt_every=3)
+        t = 0.0
+        ch.tick(t)
+        for _ in range(6):
+            t += 0.01
+            ch.tick(t)
+        assert len(created) >= 12 and deleted, (created, deleted)
+        # every burst either departed completely or not at all
+        gone = set(deleted)
+        for g, members in _bursts_of(created, ch).items():
+            departed = {m in gone for m in members}
+            assert len(departed) == 1, (
+                f"burst {g} partially departed: {members} vs {sorted(gone)}")
+        assert len(ch._live) <= ch.cap + ch.gang_size
+
+
+def _bursts_of(created, ch):
+    """Reconstruct arrival units from the stub's create order: gang bursts
+    are gang_size consecutive names, preempt bursts a single name (the
+    churner's preempt_every cadence)."""
+    units, i, burst_no = {}, 0, 0
+    while i < len(created):
+        burst_no += 1
+        size = 1 if burst_no % ch.preempt_every == 0 else ch.gang_size
+        units[burst_no] = created[i:i + size]
+        i += size
+    return units

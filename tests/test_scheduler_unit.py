@@ -456,3 +456,92 @@ class TestGenericScheduler:
         nodes = [mk_node("n1"), mk_node("n2")]
         scores = sched.prioritize_nodes(mk_pod(), {}, nodes)
         assert scores == {"n1": 15, "n2": 10}
+
+
+class TestObjectiveProviderSeam:
+    """The objective registry rides the provider boundary exactly like the
+    predicate/priority registries: register by name, select by name (config
+    or policy file), loud KeyError on unknown names."""
+
+    def test_builtin_objectives_registered(self):
+        from kubernetes_tpu.scheduler import provider
+
+        names = provider.objective_names()
+        for name in ("default", "binpack", "preempt", "gang",
+                     "gang_preempt"):
+            assert name in names
+        assert provider.get_objective("binpack").binpack
+        assert provider.get_objective("gang_preempt").gang
+        assert provider.get_objective("gang_preempt").preempt
+        assert not provider.get_objective("default").enabled
+
+    def test_register_custom_objective(self):
+        from kubernetes_tpu.scheduler import provider
+
+        cfg = provider.ObjectiveConfig(name="packed-trainings",
+                                       binpack=True, gang=True,
+                                       binpack_weight=3)
+        provider.register_objective("packed-trainings", cfg)
+        got = provider.get_objective("packed-trainings")
+        assert got is cfg and got.enabled
+
+    def test_unknown_objective_raises(self):
+        from kubernetes_tpu.scheduler import provider
+
+        with pytest.raises(KeyError, match="no-such-objective"):
+            provider.get_objective("no-such-objective")
+
+    def test_non_config_registration_rejected(self):
+        from kubernetes_tpu.scheduler import provider
+
+        with pytest.raises(TypeError):
+            provider.register_objective("bad", {"binpack": True})
+
+    def test_policy_objective_selection(self):
+        from kubernetes_tpu.scheduler.provider import (
+            PluginArgs, load_policy, policy_objective,
+        )
+
+        policy = {"predicates": [{"name": "PodFitsResources"}],
+                  "priorities": [{"name": "LeastRequestedPriority",
+                                  "weight": 2}],
+                  "objective": "binpack"}
+        assert policy_objective(policy).binpack
+        predicates, priorities, _ext = load_policy(policy, PluginArgs())
+        assert "PodFitsResources" in predicates
+        assert priorities[0].weight == 2
+
+    def test_policy_unknown_objective_fails_load(self):
+        from kubernetes_tpu.scheduler.provider import PluginArgs, load_policy
+
+        with pytest.raises(KeyError, match="typo-objective"):
+            load_policy({"predicates": [], "priorities": [],
+                         "objective": "typo-objective"}, PluginArgs())
+
+    def test_provider_objective_key(self):
+        from kubernetes_tpu.scheduler.provider import (
+            get_provider, register_algorithm_provider,
+        )
+
+        register_algorithm_provider(
+            "BinpackProviderForTest", ["PodFitsResources"],
+            ["LeastRequestedPriority", "MostRequestedPriority"],
+            objective="binpack")
+        prov = get_provider("BinpackProviderForTest")
+        assert prov["objective"] == "binpack"
+        with pytest.raises(KeyError):
+            register_algorithm_provider("BrokenProviderForTest", [], [],
+                                        objective="not-registered")
+
+    def test_most_requested_priority_math(self):
+        # the binpack objective's sequential reference: fuller nodes win,
+        # _calculate_score inverted with the same integer truncation
+        node_a = mk_node("a", cpu="4000m", mem="10Gi")
+        node_b = mk_node("b", cpu="4000m", mem="10Gi")
+        hog = mk_pod("hog", cpu="2000m", mem="5Gi", node="a")
+        info = {"a": ni(node_a, hog), "b": ni(node_b)}
+        pod = mk_pod("new", cpu="1000m", mem="2560Mi")
+        scores = prios.most_requested(pod, info, [node_a, node_b])
+        # a: cpu (2000+1000)*10/4000 = 7; mem (5G+2.5G)*10/10G = 7 -> 7
+        # b: cpu 1000*10/4000 = 2; mem 2.5*10/10 = 2 -> 2
+        assert scores == {"a": 7, "b": 2}

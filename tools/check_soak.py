@@ -49,6 +49,7 @@ def check(doc: dict, expect_wedged: bool) -> list:
         return isinstance(v, dict) and all(
             isinstance(k, str) and _is_num(n) for k, n in v.items())
 
+    gang_mode = (detail.get("config") or {}).get("scenario") == "gang_churn"
     for i, rnd in enumerate(detail.get("rounds") or []):
         where = f"detail.rounds[{i}]"
         need(rnd, "created", _is_num, where, "number")
@@ -59,6 +60,10 @@ def check(doc: dict, expect_wedged: bool) -> list:
         for key in ("pods_per_sec", "e2e_p50_seconds", "e2e_p99_seconds"):
             need(rnd, key, lambda v: v is None or _is_num(v), where,
                  "number or null (null = no samples, never fake zero)")
+        if gang_mode:
+            for key in ("preemptions", "gangs_placed", "gangs_rejected"):
+                need(rnd, key, _is_num, where,
+                     "number scraped off the objective counters")
 
     for i, slo in enumerate(detail.get("slos") or []):
         where = f"detail.slos[{i}]"
@@ -92,6 +97,15 @@ def check(doc: dict, expect_wedged: bool) -> list:
              "positive (a clean soak must bind pods)")
         need(detail, "unschedulable_reasons", _reasons_ok, "detail",
              "predicate -> count object scraped off the reasons counter")
+        if gang_mode:
+            # gang_churn's objective verdict blocks (scraped, rebased):
+            # gangs must actually place — a gang_churn soak that never
+            # co-placed a gang proved nothing
+            need(detail, "preemptions", _reasons_ok, "detail",
+                 "reason -> count object scraped off preemptions_total")
+            need(detail, "gangs_placed", lambda v: _is_num(v) and v > 0,
+                 "detail", "positive (a clean gang soak must place gangs)")
+            need(detail, "gangs_rejected", _is_num, "detail", "number")
     return errs
 
 

@@ -29,7 +29,15 @@
 #                         agrees across the Unschedulable condition, the
 #                         FailedScheduling event, /explainz, and /metrics
 #
-# Usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only|--explain-only]
+#   7. objectives smoke — tools/objectives_smoke.py runs the live scheduler
+#                         under gang_preempt: a gang co-places on one zone,
+#                         a high-priority pod forces a preemption (victim
+#                         evicted + Preempted Event), and the nomination
+#                         sentence agrees across the FailedScheduling
+#                         event, /explainz, and the objective counters on
+#                         /metrics
+#
+# Usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only|--explain-only|--objectives-only]
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -39,14 +47,16 @@ run_tests=1
 run_soak=1
 run_trace=1
 run_explain=1
+run_objectives=1
 case "${1:-}" in
-  --static-only)  run_tests=0; run_soak=0; run_trace=0; run_explain=0 ;;
-  --tests-only)   run_static=0; run_soak=0; run_trace=0; run_explain=0 ;;
-  --soak-only)    run_static=0; run_tests=0; run_trace=0; run_explain=0 ;;
-  --trace-only)   run_static=0; run_tests=0; run_soak=0; run_explain=0 ;;
-  --explain-only) run_static=0; run_tests=0; run_soak=0; run_trace=0 ;;
+  --static-only)  run_tests=0; run_soak=0; run_trace=0; run_explain=0; run_objectives=0 ;;
+  --tests-only)   run_static=0; run_soak=0; run_trace=0; run_explain=0; run_objectives=0 ;;
+  --soak-only)    run_static=0; run_tests=0; run_trace=0; run_explain=0; run_objectives=0 ;;
+  --trace-only)   run_static=0; run_tests=0; run_soak=0; run_explain=0; run_objectives=0 ;;
+  --explain-only) run_static=0; run_tests=0; run_soak=0; run_trace=0; run_objectives=0 ;;
+  --objectives-only) run_static=0; run_tests=0; run_soak=0; run_trace=0; run_explain=0 ;;
   "") ;;
-  *) echo "usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only|--explain-only]" >&2; exit 2 ;;
+  *) echo "usage: tools/verify.sh [--static-only|--tests-only|--soak-only|--trace-only|--explain-only|--objectives-only]" >&2; exit 2 ;;
 esac
 
 if [ "$run_static" = 1 ]; then
@@ -89,6 +99,11 @@ fi
 if [ "$run_explain" = 1 ]; then
   echo "== explain smoke (decision ledger: condition == event == /explainz) =="
   JAX_PLATFORMS=cpu timeout -k 10 180 python tools/explain_smoke.py
+fi
+
+if [ "$run_objectives" = 1 ]; then
+  echo "== objectives smoke (gang placement + live preemption + surface agreement) =="
+  JAX_PLATFORMS=cpu timeout -k 10 240 python tools/objectives_smoke.py
 fi
 
 echo "verify: OK"
