@@ -1056,6 +1056,9 @@ def main_soak() -> int:
         gang_size=int(os.environ.get("SOAK_GANG_SIZE", 3)),
         preempt_every=int(os.environ.get("SOAK_PREEMPT_EVERY", 8)),
         objective=os.environ.get("SOAK_OBJECTIVE", ""),
+        apiservers=int(os.environ.get("SOAK_APISERVERS", 2)),
+        store_members=int(os.environ.get("SOAK_STORE_MEMBERS", 3)),
+        kill_at_fraction=float(os.environ.get("SOAK_KILL_AT", 0.4)),
     )
     report = run_soak(cfg)
     steady = report.get("steady_state") or {}
@@ -1093,7 +1096,18 @@ def parse_mode(argv) -> str:
         help="scheduling-objective config for the overhead gate (batch "
              "mode: detail.objective_overhead) or the soak's scheduler "
              "(soak mode)")
+    p.add_argument(
+        "--scenario",
+        choices=("churn", "gang_churn", "leader_kill"),
+        default=os.environ.get("SOAK_SCENARIO", "churn"),
+        help="soak-mode scenario: plain churn, gang churn under "
+             "gang_preempt, or leader_kill — churn against a 3-member "
+             "replicated store + 2 apiservers behind the discovery proxy "
+             "with the storage leader and an apiserver killed mid-run "
+             "(report gains a `failover` block; lost acked bindings wedge "
+             "the run)")
     args = p.parse_args(argv)
+    os.environ["SOAK_SCENARIO"] = args.scenario
     # downstream code reads these through the env (the soak subprocess and
     # the gate helper both live behind run_with_timeout seams)
     os.environ["BENCH_OBJECTIVE"] = args.objective

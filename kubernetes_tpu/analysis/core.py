@@ -170,9 +170,13 @@ def all_checkers() -> List[Checker]:
         SwallowedExceptionChecker,
     )
     from kubernetes_tpu.analysis.locks import LockHeldAcrossIOChecker
+    from kubernetes_tpu.analysis.replication_io import (
+        ReplicationLockIOChecker,
+    )
     from kubernetes_tpu.analysis.spans import LeakedSpanChecker
     return [
         LockHeldAcrossIOChecker(),
+        ReplicationLockIOChecker(),
         CacheMutationChecker(),
         HostSyncChecker(),
         SwallowedExceptionChecker(),

@@ -1,0 +1,109 @@
+"""replication-lock-io: replication traffic or fsync under a store lock.
+
+The replicated control plane's write pipeline is only safe for readers
+because its split is STRUCTURAL: mutations stage under the store/member
+lock, but the replication round-trip (transport sends to other members)
+and every durability syscall (fsync) happen outside it, serialized by
+writer batons (`_commit_gate` / `_ship_gate`) that readers and watchers
+never touch. Collapse that split — ship or fsync while holding a lock —
+and one slow follower or one slow disk stalls every read, watch
+delivery, and CAS loop in the process: the same bug class as the round-5
+volume manager (PVC resolution under the manager-wide lock), one layer
+lower where it is strictly worse.
+
+This checker makes the obvious regression impossible to ship:
+
+- a call to a replication RPC (``append_entries``, ``request_vote``,
+  ``install_snapshot``, ``read_log_tail``, or any method on a receiver
+  naming a transport/peer) inside a ``with <lock>:`` body
+- ``os.fsync`` / ``os.fdatasync`` inside a ``with <lock>:`` body
+
+"Lock" uses the same terminal-name heuristic as lock-held-across-io
+(``self._lock``, ``mu``, ...): the batons are deliberately NOT locks by
+that heuristic — holding a writer baton across the round-trip is the
+design, holding the reader-visible lock across it is the bug.
+
+Like its sibling, this is a lexical same-scope pass: indirect flows
+(``with lock: self._helper()`` where the helper ships) are the runtime
+lock-order tracker's and review's job. The one legitimate
+fsync-near-lock in the repo — DurableStore's WAL append, where fsync
+must precede publish under the single-store lock by contract — is a
+function *called with* the lock held, not a ``with`` body, and so stays
+out of scope by the same rule. Baseline: empty, and it stays empty.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from kubernetes_tpu.analysis.core import (
+    Checker,
+    FileContext,
+    Finding,
+    chain_text,
+    dotted_chain,
+)
+from kubernetes_tpu.analysis.locks import LockHeldAcrossIOChecker, is_lock_expr
+
+# the replication RPC surface: anything on this list is a send to (or a
+# durable read on behalf of) another member — never under a lock
+_REPL_VERBS = {
+    "append_entries", "request_vote", "install_snapshot", "read_log_tail",
+    "catch_up", "replicate", "ship", "send_entries", "heartbeat",
+}
+
+_SYNC_CALLS = {"fsync", "fdatasync"}
+
+
+def _replication_reason(call: ast.Call) -> Optional[str]:
+    chain = dotted_chain(call.func)
+    if not chain:
+        return None
+    head, last = chain[0], chain[-1]
+    receiver = ".".join(chain[:-1])
+    if head == "os" and last in _SYNC_CALLS:
+        return (f"os.{last}() is a durability syscall (milliseconds to "
+                "seconds on a loaded disk)")
+    if last in _REPL_VERBS:
+        return f"{receiver + '.' if receiver else ''}{last}() is replication traffic"
+    if chain[:-1] and any(w in chain[-2].lower()
+                          for w in ("transport", "peer")):
+        return f"{receiver}.{last}() goes through the member transport"
+    return None
+
+
+class ReplicationLockIOChecker(Checker):
+    name = "replication-lock-io"
+    description = ("replication sends (append_entries/request_vote/"
+                   "install_snapshot/transport.*) or fsync inside a "
+                   "`with <lock>:` body — stage under the lock, ship and "
+                   "sync outside it")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                lock_expr = item.context_expr
+                if isinstance(lock_expr, ast.Call):
+                    lock_expr = lock_expr.func
+                    if isinstance(lock_expr, ast.Attribute) and \
+                            lock_expr.attr in ("acquire", "acquire_read",
+                                               "acquire_write"):
+                        lock_expr = lock_expr.value
+                if not is_lock_expr(lock_expr):
+                    continue
+                lock_text = chain_text(lock_expr)
+                for inner in LockHeldAcrossIOChecker._body_nodes(node):
+                    if not isinstance(inner, ast.Call):
+                        continue
+                    reason = _replication_reason(inner)
+                    if reason:
+                        yield self.finding(
+                            ctx, inner,
+                            f"{reason} while holding "
+                            f"{lock_text or 'a lock'} — the rotate-under-"
+                            "lock/ship-outside-lock split must be "
+                            "structural")

@@ -50,7 +50,7 @@ from kubernetes_tpu.registry.generic import (
 from kubernetes_tpu.observability.audit import (
     AUDIT, AuditRecord, now_iso, render_auditz,
 )
-from kubernetes_tpu.storage import TooOldResourceVersion
+from kubernetes_tpu.storage import NoQuorum, TooOldResourceVersion
 from kubernetes_tpu.storage import store as store_mod
 from kubernetes_tpu.utils import trace
 from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
@@ -213,6 +213,9 @@ class _Handler(BaseHTTPRequestHandler):
     registry: Registry = None  # set per-server subclass
     server_ref: APIServer = None
     protocol_version = "HTTP/1.1"
+    # Nagle off: a delayed-ACK peer otherwise costs ~40ms per small
+    # response (watch frames, Status bodies) — see utils/nethost.py
+    disable_nagle_algorithm = True
 
     # silence per-request stderr logging
     def log_message(self, fmt, *args):
@@ -329,6 +332,12 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_status(e.code, e.reason, e.message)
                 except TooOldResourceVersion as e:
                     self._send_status(410, "Expired", str(e))
+                except NoQuorum as e:
+                    # the replicated store could not reach a durable
+                    # majority: outcome unknown — clients re-read + retry,
+                    # exactly the reference's etcd-timeout surface
+                    METRICS.inc("apiserver_storage_noquorum", verb=method)
+                    self._send_status(503, "ServiceUnavailable", str(e))
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # HandleCrash equivalent

@@ -93,11 +93,15 @@ class LeaderElector:
         if old is not None:
             if old != self._observed_record:
                 self._observe(old, now)
-            held_by_other = old.get("holderIdentity") != self.cfg.identity
+            holder = old.get("holderIdentity")
+            # an empty holder is a RELEASED lease (graceful shutdown zeroed
+            # it): immediately acquirable — the successor must not wait out
+            # a lease nobody holds
+            held_by_other = bool(holder) and holder != self.cfg.identity
             lease_valid = (self._observed_time + self.cfg.lease_duration) > now
             if held_by_other and lease_valid:
                 return False  # someone else holds an unexpired lease
-            if not held_by_other:
+            if holder == self.cfg.identity:
                 record["acquireTime"] = old.get("acquireTime", wall_now)
         ep.metadata.annotations = dict(ann)
         ep.metadata.annotations[LEADER_ANNOTATION] = json.dumps(record)
@@ -158,7 +162,50 @@ class LeaderElector:
                 log.exception("on_stopped_leading callback failed; "
                               "continuing to re-acquire")
 
+    def release(self) -> bool:
+        """Zero the lease record so a successor acquires IMMEDIATELY instead
+        of waiting out lease_duration (the reference's releaseOnCancel).
+        Best-effort CAS: only our own unexpired record is zeroed — racing a
+        successor that already took the lease must not evict it."""
+        import http.client as _http
+        try:
+            ep = self.client.get("endpoints", self.cfg.lock_name,
+                                 self.cfg.lock_namespace)
+        except (ApiError, OSError, _http.HTTPException):
+            # a graceful stop may race the apiserver's own shutdown —
+            # failing to release degrades to the crash path (the successor
+            # waits out the lease); stop() itself must never raise
+            return False
+        ann = ep.metadata.annotations or {}
+        raw = ann.get(LEADER_ANNOTATION)
+        old = json.loads(raw) if raw else None
+        if not old or old.get("holderIdentity") != self.cfg.identity:
+            return False  # not ours (anymore): leave it alone
+        released = dict(old)
+        released["holderIdentity"] = ""
+        released["renewTime"] = time.time()
+        ep.metadata.annotations = dict(ann)
+        ep.metadata.annotations[LEADER_ANNOTATION] = json.dumps(released)
+        try:
+            self.client.update("endpoints", ep, self.cfg.lock_namespace)
+        except (ApiError, OSError, _http.HTTPException):
+            return False  # CAS lost (or server gone): leave it to expiry
+        return True
+
     def stop(self):
+        # capture before signalling: the loop clears _is_leader on its way
+        # out, and release() itself CAS-guards against a lease we no longer
+        # hold, so a stale True here cannot evict a successor
+        was_leader = self._is_leader
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if was_leader:
+            # graceful handover: a cleanly-stopped leader releases instead
+            # of making the successor wait out the full lease duration —
+            # the chaos soak measures this as election_handover_seconds
+            self._is_leader = False
+            if self.release():
+                log.info("released leader lease %s/%s (identity %s)",
+                         self.cfg.lock_namespace, self.cfg.lock_name,
+                         self.cfg.identity)

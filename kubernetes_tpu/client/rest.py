@@ -197,14 +197,19 @@ class RESTClient:
         return ctx
 
     def _new_conn(self, timeout: float) -> http.client.HTTPConnection:
+        # NODELAY variants: Nagle + delayed ACK costs ~40ms on every small
+        # request — see utils/nethost.py
+        from kubernetes_tpu.utils.nethost import (
+            NoDelayHTTPConnection, NoDelayHTTPSConnection,
+        )
         if self.tls:
             if self.insecure_skip_verify:
                 METRICS.inc("tls_insecure_connections")
-            return http.client.HTTPSConnection(
+            return NoDelayHTTPSConnection(
                 self.host, self.port, timeout=timeout,
                 context=self._ssl_context())
-        return http.client.HTTPConnection(self.host, self.port,
-                                          timeout=timeout)
+        return NoDelayHTTPConnection(self.host, self.port,
+                                     timeout=timeout)
 
     def _conn(self) -> http.client.HTTPConnection:
         # one keep-alive connection per thread

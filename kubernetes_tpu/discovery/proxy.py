@@ -13,29 +13,55 @@ the aggregation pattern it grew into):
 
 Forwarding is transparent at the HTTP layer: method, query string, body,
 and content-type travel as-is, so watches stream through chunk by chunk.
+
+Member rotation is health-gated (the multi-apiserver half of ROADMAP item
+4): an upstream whose connection fails before any response byte enters a
+short cooldown and the request is retried against the next healthy
+upstream — a killed apiserver costs its in-flight streams (clients
+re-list, the Reflector contract) but never takes the proxy's route with
+it. /healthz degrades instead of failing: 200 while ANY upstream lives.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse
 
 import http.client
 
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
 from kubernetes_tpu.utils.nethost import parse_host_port
+
+# an upstream that refused a connection is skipped for this long before
+# being re-tried — long enough to stop hammering a corpse, short enough
+# that a restarted apiserver rejoins the rotation promptly
+DOWN_COOLDOWN_SECONDS = 2.0
 
 
 class _Upstream:
     def __init__(self, address: str):
         self.host, self.port = parse_host_port(address)
         self.address = address
+        # monotonic timestamp until which this upstream sits out rotation
+        self.down_until = 0.0
+
+    def mark_down(self) -> None:
+        self.down_until = time.monotonic() + DOWN_COOLDOWN_SECONDS
+
+    def mark_up(self) -> None:
+        self.down_until = 0.0
+
+    @property
+    def in_cooldown(self) -> bool:
+        return time.monotonic() < self.down_until
 
     def conn(self, timeout: float = 30.0) -> http.client.HTTPConnection:
-        return http.client.HTTPConnection(self.host, self.port,
-                                          timeout=timeout)
+        from kubernetes_tpu.utils.nethost import NoDelayHTTPConnection
+        return NoDelayHTTPConnection(self.host, self.port, timeout=timeout)
 
     def get_json(self, path: str):
         conn = self.conn(timeout=5)
@@ -48,6 +74,19 @@ class _Upstream:
             return json.loads(data)
         finally:
             conn.close()
+
+
+class _UpstreamDown(Exception):
+    """The upstream failed before any response byte. `request_unsent` is
+    True when the failure happened while still SENDING (connect/request):
+    the upstream provably never received it, so any verb may rotate; False
+    means the request was delivered but never answered — the upstream may
+    have executed it, and only idempotent verbs may be replayed."""
+
+    def __init__(self, cause: BaseException, request_unsent: bool):
+        super().__init__(str(cause))
+        self.cause = cause
+        self.request_unsent = request_unsent
 
 
 class DiscoveryProxy:
@@ -86,6 +125,19 @@ class DiscoveryProxy:
                 up = self._group_map.get(group)
         return up
 
+    def candidates(self, preferred: Optional[_Upstream] = None
+                   ) -> List[_Upstream]:
+        """Forwarding order: the preferred upstream (group owner / primary)
+        first, then the rest — each tier healthy-before-cooldown, so a dead
+        primary rotates out for DOWN_COOLDOWN_SECONDS but a fully-down
+        fleet is still attempted (last-resort: cooldowns may be stale)."""
+        ordered: List[_Upstream] = []
+        if preferred is not None:
+            ordered.append(preferred)
+        ordered.extend(u for u in self.upstreams if u is not preferred)
+        healthy = [u for u in ordered if not u.in_cooldown]
+        return healthy + [u for u in ordered if u.in_cooldown]
+
     def merged_groups(self) -> dict:
         groups, seen = [], set()
         for up in self.upstreams:
@@ -109,6 +161,7 @@ class DiscoveryProxy:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True  # see utils/nethost.py
 
             def log_message(self, fmt, *args):
                 pass
@@ -124,15 +177,23 @@ class DiscoveryProxy:
             def _route(self):
                 path = urlparse(self.path).path
                 if path == "/healthz":
+                    up_addrs, down_addrs = [], []
                     for up in outer.upstreams:
                         try:
                             ok = up.get_json("/api") is not None
                         except Exception:
                             ok = False
-                        if not ok:
-                            return self._send_json(
-                                503, {"status": "unhealthy",
-                                      "upstream": up.address})
+                        (up_addrs if ok else down_addrs).append(up.address)
+                        (up.mark_up if ok else up.mark_down)()
+                    if not up_addrs:
+                        return self._send_json(
+                            503, {"status": "unhealthy",
+                                  "down": down_addrs})
+                    if down_addrs:
+                        # degraded, not dead: rotation still has members
+                        return self._send_json(
+                            200, {"status": "degraded", "up": up_addrs,
+                                  "down": down_addrs})
                     body = b"ok"
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
@@ -151,26 +212,71 @@ class DiscoveryProxy:
                                   "reason": "NotFound",
                                   "message": f"no upstream serves group "
                                              f"{group!r}"})
-                    return self._forward(up)
-                # core API + everything else: the primary upstream
-                return self._forward(outer.upstreams[0])
+                    return self._forward(outer.candidates(up))
+                # core API + everything else: the primary upstream first,
+                # health-gated rotation behind it
+                return self._forward(outer.candidates(outer.upstreams[0]))
 
-            def _forward(self, up: _Upstream):
+            def _forward(self, ups: List[_Upstream]):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else None
+                headers = {}
+                # hop-safe headers travel as-is — including the tracing
+                # pair: without traceparent/x-ktpu-retries the apiserver
+                # would mint a fresh root trace for every proxied request
+                # and audit records would lose component + retry-ordinal
+                # attribution (the failover bundle correlates on these)
+                for h in ("Content-Type", "Accept", "Authorization",
+                          "User-Agent", "traceparent", "x-ktpu-retries"):
+                    if self.headers.get(h):
+                        headers[h] = self.headers[h]
+                # Rotation policy: a failure while SENDING the request
+                # means the upstream never received it — always safe to
+                # re-send to the next member. A failure after the send
+                # (getresponse) means the upstream may already have
+                # EXECUTED it; replaying a non-idempotent verb there could
+                # double-apply, so only idempotent reads rotate (the same
+                # rule rest.py applies to its own keep-alive retries) —
+                # everything else surfaces as 502 and the client's own
+                # retry semantics (CAS re-read, re-list) take over.
+                last_err: Optional[BaseException] = None
+                for up in ups:
+                    try:
+                        self._forward_one(up, body, headers)
+                        return
+                    except _UpstreamDown as e:
+                        up.mark_down()
+                        last_err = e.cause
+                        METRICS.inc("discovery_proxy_rotations",
+                                    upstream=up.address)
+                        if not e.request_unsent and \
+                                self.command not in ("GET", "HEAD"):
+                            break
+                        continue
+                down = ups[-1] if ups else None
+                try:
+                    self._send_json(502, {
+                        "kind": "Status", "code": 502,
+                        "reason": "BadGateway",
+                        "message": f"no upstream reachable "
+                                   f"(last: {down.address if down else '?'}"
+                                   f": {last_err})"})
+                except OSError:
+                    pass
+
+            def _forward_one(self, up: _Upstream, body, headers):
                 # watches idle between events; the upstream heartbeats
                 # every ~30s, so 120s only trips on a truly dead upstream
                 conn = up.conn(timeout=120)
                 started = False
+                sent = False
                 try:
-                    headers = {}
-                    for h in ("Content-Type", "Accept", "Authorization"):
-                        if self.headers.get(h):
-                            headers[h] = self.headers[h]
                     conn.request(self.command, self.path, body=body,
                                  headers=headers)
+                    sent = True
                     resp = conn.getresponse()
                     started = True
+                    up.mark_up()
                     self.send_response(resp.status)
                     chunked = (resp.getheader("Transfer-Encoding", "")
                                .lower() == "chunked")
@@ -195,26 +301,24 @@ class DiscoveryProxy:
                         self.send_header("Content-Length", str(len(data)))
                         self.end_headers()
                         self.wfile.write(data)
-                except (BrokenPipeError, ConnectionResetError):
-                    pass
-                except OSError as e:
-                    if started:
-                        # mid-stream failure: a second status line would
-                        # corrupt the chunked body — close; the client's
-                        # short read triggers its re-list/retry path
-                        try:
-                            self.wfile.write(b"0\r\n\r\n")
-                        except OSError:
-                            pass
-                        self.close_connection = True
-                        return
+                except (http.client.HTTPException, OSError) as e:
+                    if not started:
+                        # the upstream never answered; whether it may have
+                        # EXECUTED the request (sent=True) decides if the
+                        # caller is allowed to replay it
+                        raise _UpstreamDown(e, request_unsent=not sent) \
+                            from e
+                    if isinstance(e, (BrokenPipeError,
+                                      ConnectionResetError)):
+                        return  # the CLIENT went away mid-stream
+                    # mid-stream upstream failure: a second status line
+                    # would corrupt the chunked body — close; the client's
+                    # short read triggers its re-list/retry path
                     try:
-                        self._send_json(502, {
-                            "kind": "Status", "code": 502,
-                            "reason": "BadGateway",
-                            "message": f"upstream {up.address}: {e}"})
+                        self.wfile.write(b"0\r\n\r\n")
                     except OSError:
                         pass
+                    self.close_connection = True
                 finally:
                     conn.close()
 

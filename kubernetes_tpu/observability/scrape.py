@@ -258,7 +258,8 @@ class Scraper:
     # --- collection ----------------------------------------------------------
 
     def _fetch(self, host: str, port: int, path: str) -> str:
-        conn = http.client.HTTPConnection(host, port, timeout=self._timeout)
+        from kubernetes_tpu.utils.nethost import NoDelayHTTPConnection
+        conn = NoDelayHTTPConnection(host, port, timeout=self._timeout)
         try:
             conn.request("GET", path)
             resp = conn.getresponse()

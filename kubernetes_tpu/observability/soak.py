@@ -56,13 +56,24 @@ class SoakConfig:
     batch_size: int = 256
     heartbeat_period: float = 10.0
     drain_timeout: float = 30.0       # wait for stragglers after churn
-    # scenario: "churn" (singleton pods) or "gang_churn" — gangs of
+    # scenario: "churn" (singleton pods), "gang_churn" — gangs of
     # `gang_size` pods arriving/departing as units under the gang_preempt
     # objective, with an occasional whole-node high-priority pod applying
-    # preemption pressure (every `preempt_every`-th creation burst)
+    # preemption pressure (every `preempt_every`-th creation burst) — or
+    # "leader_kill": the same churn against a 3-member ReplicatedStore and
+    # `apiservers` API servers behind the discovery proxy, with the storage
+    # LEADER and one apiserver killed mid-churn (chaos as a first-class
+    # scenario, ROADMAP item 4) — the report must show zero lost acked
+    # bindings, the failover window, and a flight-recorder bundle
     scenario: str = "churn"
     gang_size: int = 3
     preempt_every: int = 8
+    # leader_kill knobs
+    apiservers: int = 2
+    store_members: int = 3
+    kill_at_fraction: float = 0.4     # of duration_seconds into the churn
+    rejoin_after: float = 1.0         # seconds after the kill
+    data_dir: str = ""                # member data dirs; "" = mkdtemp
     objective: str = ""               # "" = scenario default
     # SLO objectives (specs built in default_slos; override via `slos`)
     slo_pods_per_sec: float = 0.0     # 0 = half the create rate
@@ -369,7 +380,10 @@ def _soak_phases(cfg: SoakConfig, report: dict, state: dict, stage,
 
 def _boot(cfg: SoakConfig, state: dict, scraper: Optional[Scraper]) -> None:
     """API server + debugserver + HollowCluster + batch scheduler + scraper
-    baseline round."""
+    baseline round. leader_kill boots the replicated control plane instead:
+    3-member quorum store under one Registry served by 2 apiservers behind
+    the health-gated discovery proxy — every client below talks to the
+    PROXY, so the chaos kills exercise the real failover paths."""
     from kubernetes_tpu.api import binary_codec
     from kubernetes_tpu.apiserver import APIServer
     from kubernetes_tpu.client import RESTClient
@@ -378,13 +392,23 @@ def _boot(cfg: SoakConfig, state: dict, scraper: Optional[Scraper]) -> None:
     from kubernetes_tpu.scheduler.factory import ConfigFactory
     from kubernetes_tpu.utils.debugserver import DebugServer
 
-    server = state["server"] = APIServer().start()
-    client = state["client"] = RESTClient.for_server(
-        server, qps=50000, burst=50000,
-        content_type=binary_codec.CONTENT_TYPE)
+    if cfg.scenario == "leader_kill":
+        _boot_replicated_plane(cfg, state)
+        server = state["server"]
+        mk = lambda: RESTClient(port=state["proxy"].port,  # noqa: E731
+                                qps=50000, burst=50000)
+        client = state["client"] = RESTClient(
+            port=state["proxy"].port, qps=50000, burst=50000,
+            content_type=binary_codec.CONTENT_TYPE)
+    else:
+        server = state["server"] = APIServer().start()
+        mk = lambda: RESTClient.for_server(  # noqa: E731
+            server, qps=50000, burst=50000)
+        client = state["client"] = RESTClient.for_server(
+            server, qps=50000, burst=50000,
+            content_type=binary_codec.CONTENT_TYPE)
     hollow = state["hollow"] = HollowCluster(
-        RESTClient.for_server(server, qps=50000, burst=50000),
-        num_nodes=cfg.num_nodes)
+        mk(), num_nodes=cfg.num_nodes)
     hollow.start(heartbeat_period=cfg.heartbeat_period)
     factory = state["factory"] = ConfigFactory(client)
     factory.run(timeout=60)
@@ -427,6 +451,157 @@ def _boot(cfg: SoakConfig, state: dict, scraper: Optional[Scraper]) -> None:
     sched.run()
 
 
+def _boot_replicated_plane(cfg: SoakConfig, state: dict) -> None:
+    """The leader_kill substrate: ReplicatedStore (3 members) -> one shared
+    Registry -> `cfg.apiservers` APIServers -> DiscoveryProxy. Also arms
+    the chaos plan, the bind ledger (acked-write loss detection), and the
+    controller-leader-election handover probe."""
+    import tempfile
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import RESTClient
+    from kubernetes_tpu.client.leaderelection import (
+        LeaderElectionConfig, LeaderElector,
+    )
+    from kubernetes_tpu.discovery import DiscoveryProxy
+    from kubernetes_tpu.registry.generic import Registry
+    from kubernetes_tpu.storage import ReplicatedStore
+
+    data_dir = cfg.data_dir or tempfile.mkdtemp(prefix="ktpu-leaderkill-")
+    store = state["store"] = ReplicatedStore.local(
+        data_dir, n=cfg.store_members, heartbeat_period=0.25,
+        window=65536, watcher_queue=65536)
+    registry = Registry(store)
+    servers = state["servers"] = [APIServer(registry).start()
+                                  for _ in range(max(cfg.apiservers, 2))]
+    state["server"] = servers[0]
+    proxy = state["proxy"] = DiscoveryProxy(
+        [f"127.0.0.1:{s.port}" for s in servers]).start()
+
+    # acked-bind ledger: watch the FACADE, whose events publish only after
+    # the quorum ack — exactly the set of binds the cluster acknowledged.
+    # Anything recorded here and later absent/unbound (without a DELETE
+    # event) is a lost acknowledged write.
+    state["ledger"] = {}
+    state["ledger_watch"] = store.watch("/pods/")
+    state["lost_bindings_events"] = 0
+
+    # controller/scheduler leader election must span apiserver failover:
+    # two electors race for one lease through the proxy; the chaos step
+    # gracefully stops the incumbent and measures successor acquisition
+    # (the release-on-stop satellite's number)
+    state["elect_flags"] = flags = {"a": False, "b": False}
+    le_cfg = dict(lock_namespace="default", lock_name="soak-leader",
+                  lease_duration=3.0, renew_deadline=2.0, retry_period=0.2)
+
+    def mk_elector(name):
+        return LeaderElector(
+            RESTClient(port=proxy.port, qps=1000, burst=1000,
+                       user_agent=f"soak-elector-{name}"),
+            LeaderElectionConfig(identity=f"cm-{name}", **le_cfg),
+            on_started_leading=lambda: flags.__setitem__(name, True),
+            on_stopped_leading=lambda: None)
+
+    state["elector_a"] = mk_elector("a").run()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not state["elector_a"].is_leader:
+        time.sleep(0.05)
+    state["elector_b"] = mk_elector("b").run()
+    state["chaos"] = {"done": False, "rejoined": False,
+                      "killed_member": None, "killed_apiserver": None}
+
+
+def _drain_ledger(state: dict, timeout: float = 0.0) -> None:
+    """Pull pending post-quorum events into the acked-bind ledger. An event
+    setting spec.nodeName records the ack; DELETED forgets the pod; an
+    OBSERVED un-bind (nodeName present then gone without a delete) is a
+    lost acked write the moment it happens."""
+    w = state.get("ledger_watch")
+    if w is None:
+        return
+    ledger = state["ledger"]
+    while True:
+        ev = w.next(timeout=timeout)
+        if ev is None:
+            return
+        if ev.type == "ERROR":
+            # slow-watcher drop: the ledger is blind from here — the
+            # verdict must say so rather than claim zero loss
+            state["ledger_watch"] = None
+            state["ledger_dropped"] = True
+            return
+        key = ev.key
+        if ev.type == "DELETED":
+            ledger.pop(key, None)
+            continue
+        node = ((ev.obj.get("spec") or {}).get("nodeName")) or ""
+        if node:
+            ledger[key] = node
+        elif key in ledger:
+            state["lost_bindings_events"] += 1
+
+
+def _inject_chaos(cfg: SoakConfig, state: dict) -> None:
+    """The leader_kill moment: kill the storage leader AND the primary
+    apiserver mid-churn, and gracefully stop the incumbent controller
+    leader — then keep churning. Everything above L0 must ride it out."""
+    chaos = state["chaos"]
+    chaos["done"] = True
+    group = state["store"].group
+    chaos["killed_member"] = group.kill_leader()
+    # kill the PRIMARY apiserver: the proxy's preferred member, so the
+    # rotation path is actually exercised (informers re-list through it)
+    victim = state["servers"][0]
+    chaos["killed_apiserver"] = f"127.0.0.1:{victim.port}"
+    victim.stop()
+    chaos["handover_t0"] = time.monotonic()
+    # graceful stop releases the lease — but stop() joins the elector's
+    # renew thread, which may sit in a request to the apiserver that just
+    # died; the churn loop must not wait that out
+    import threading
+    threading.Thread(target=state["elector_a"].stop,
+                     name="chaos-elector-stop", daemon=True).start()
+    chaos["t"] = time.monotonic()
+    RECORDER.note("chaos_leader_kill",
+                  killed_member=chaos["killed_member"],
+                  killed_apiserver=chaos["killed_apiserver"])
+    RECORDER.snapshot_metrics()
+    log.warning("chaos: killed storage leader %s and apiserver %s",
+                chaos["killed_member"], chaos["killed_apiserver"])
+
+
+def _tick_chaos(cfg: SoakConfig, state: dict, now: float) -> None:
+    """Per-loop chaos bookkeeping for leader_kill: fire the kill at its
+    offset, rejoin the killed member after `rejoin_after`, record the
+    elector handover when the successor takes the lease."""
+    chaos = state.get("chaos")
+    if chaos is None:
+        return
+    # drain every tick from boot: the ledger watch has a bounded queue,
+    # and at 1k-node scale the pre-kill churn alone would overflow it —
+    # a dropped watcher makes the loss verdict wrong in both directions
+    _drain_ledger(state)
+    t0 = state.get("t0", now)
+    if not chaos["done"]:
+        if now - t0 >= cfg.duration_seconds * cfg.kill_at_fraction:
+            _inject_chaos(cfg, state)
+        return
+    if "handover_t0" in chaos and "handover_seconds" not in chaos \
+            and state["elector_b"].is_leader:
+        chaos["handover_seconds"] = time.monotonic() - chaos["handover_t0"]
+        RECORDER.note("leader_lease_handover",
+                      seconds=chaos["handover_seconds"])
+    if not chaos["rejoined"] and chaos["killed_member"] is not None \
+            and now - chaos["t"] >= cfg.rejoin_after:
+        chaos["rejoined"] = True
+        try:
+            state["store"].group.restart_member(chaos["killed_member"])
+            RECORDER.note("chaos_member_rejoined",
+                          member=chaos["killed_member"])
+        except Exception:
+            log.exception("rejoin of killed member failed")
+
+
 def _seed_hang(sched, stage_name: str) -> None:
     """Fault injection: every kernel batch parks inside `stage_name` (with a
     tiny deadline so the scheduler's watchdog converts it) — the soak must
@@ -458,6 +633,7 @@ def _churn(cfg: SoakConfig, state: dict, report: dict) -> None:
         if now >= stop:
             break
         churner.tick(now)
+        _tick_chaos(cfg, state, now)
         if now >= next_scrape:
             next_scrape = now + cfg.scrape_period
             scr.scrape()
@@ -582,6 +758,8 @@ def _finalize(cfg: SoakConfig, state: dict, report: dict) -> None:
             last, state.get("preempt_base", {}), PREEMPT_COUNTER, "reason")
         out["gangs_placed"] = gangs.get("placed", 0.0)
         out["gangs_rejected"] = gangs.get("rejected", 0.0)
+    if cfg.scenario == "leader_kill":
+        _finalize_leader_kill(cfg, state, out)
     out["kernel"] = {
         "batches": sched.kernel_batches, "pods": sched.kernel_pods,
         "failures": sched.kernel_failures, "health": sched.health,
@@ -619,10 +797,65 @@ def _finalize(cfg: SoakConfig, state: dict, report: dict) -> None:
     report.update(out)
 
 
+def _finalize_leader_kill(cfg: SoakConfig, state: dict, out: dict) -> None:
+    """The chaos verdict: every acked bind still present, the failover
+    window, lease handover time, and member convergence — plus the
+    flight-recorder bundle that captures the window (the acceptance
+    artifact even on a clean run)."""
+    chaos = state.get("chaos") or {}
+    group = state["store"].group
+    _drain_ledger(state, timeout=0.5)
+    ledger = state.get("ledger", {})
+    lost = state.get("lost_bindings_events", 0)
+    store = state["store"]
+    for key, node in ledger.items():
+        try:
+            obj, _rv = store.get(key)
+        except Exception:
+            lost += 1  # acked bind vanished without a DELETE event
+            continue
+        if ((obj.get("spec") or {}).get("nodeName") or "") != node:
+            lost += 1
+    failover = {
+        "killed_member": chaos.get("killed_member"),
+        "killed_apiserver": chaos.get("killed_apiserver"),
+        "chaos_fired": bool(chaos.get("done")),
+        "failover_seconds": finite_round(max(group.failovers), 4)
+        if group.failovers else None,
+        "leader_transitions": group.leader_transitions,
+        "lost_bindings": lost,
+        "acked_binds_tracked": len(ledger),
+        "election_handover_seconds": finite_round(
+            chaos["handover_seconds"], 3)
+        if "handover_seconds" in chaos else None,
+        "member_rejoined": bool(chaos.get("rejoined")),
+        "members_converged": group.converged(),
+        "quorum_members_alive": len(group.alive_members()),
+        "ledger_dropped": bool(state.get("ledger_dropped")),
+    }
+    out["failover"] = failover
+    if state.get("ledger_dropped"):
+        # a blind ledger cannot prove zero loss — never report it as such
+        out["wedged"] = True
+        out.setdefault("error", "acked-bind ledger watch was dropped; "
+                                "loss verdict unprovable")
+    if lost or (chaos.get("done") and not group.failovers):
+        # lost acked writes — or the kill never produced a failover at
+        # all — is exactly the dishonesty this scenario exists to catch
+        out["wedged"] = True
+        out.setdefault("error",
+                       f"leader_kill verdict failed: lost_bindings={lost}, "
+                       f"failovers={group.failovers}")
+    # the failover window's black box ships on every leader_kill run —
+    # spans, audit tail, chaos notes, SLO verdicts around the kill
+    _attach_bundle(out, "leader-kill-failover", {"failover": failover})
+
+
 def _teardown(state: dict) -> None:
     for key, stopper in (("sched", "stop"), ("factory", "stop"),
                          ("hollow", "stop"), ("debug", "stop"),
-                         ("server", "stop")):
+                         ("elector_a", "stop"), ("elector_b", "stop"),
+                         ("ledger_watch", "stop"), ("proxy", "stop")):
         obj = state.get(key)
         if obj is None:
             continue
@@ -630,3 +863,16 @@ def _teardown(state: dict) -> None:
             getattr(obj, stopper)()
         except Exception:
             log.exception("soak teardown: %s failed", key)
+    for server in state.get("servers", [state.get("server")]):
+        if server is None:
+            continue
+        try:
+            server.stop()
+        except Exception:
+            log.exception("soak teardown: apiserver stop failed")
+    store = state.get("store")
+    if store is not None:
+        try:
+            store.close()
+        except Exception:
+            log.exception("soak teardown: store close failed")

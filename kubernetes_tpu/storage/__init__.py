@@ -14,3 +14,6 @@ from kubernetes_tpu.storage.store import (
     TooOldResourceVersion, ADDED, MODIFIED, DELETED,
 )
 from kubernetes_tpu.storage.durable import DurableStore
+from kubernetes_tpu.storage.replicated import (
+    NoQuorum, ReplicatedStore, ReplicationGroup, StoreMember,
+)

@@ -51,6 +51,21 @@ WAL = "wal.log"
 WAL_OLD = "wal.log.1"
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a directory: os.replace/os.remove only become durable once the
+    containing directory's metadata hits disk (POSIX rename semantics — the
+    file's own fsync says nothing about its NAME). Called after compaction
+    renames so a crash cannot resurrect a deleted WAL segment next to the
+    snapshot that superseded it. Never call this while holding a store lock
+    (kube-verify replication-lock-io polices the replication layer's copy
+    of this rule)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class DurableStore(MemStore):
     """MemStore + WAL/snapshot persistence. Drop-in for Registry(store=...)."""
 
@@ -63,7 +78,10 @@ class DurableStore(MemStore):
         self._snapshot_every = snapshot_every
         self._ops_since_snapshot = 0
         self._snapshotting = False
+        self._snapshot_thread: Optional[threading.Thread] = None
+        self._closed = False
         self.replayed = 0   # WAL entries applied during recovery
+        self.dropped_entries = 0  # WAL lines discarded past a torn line
         os.makedirs(data_dir, exist_ok=True)
         self._recover()
         self._wal = open(os.path.join(data_dir, WAL), "a",
@@ -87,17 +105,36 @@ class DurableStore(MemStore):
                           snap["data"].items()}
         # rotated-but-uncompacted segment first (crash mid-snapshot), then
         # the live log; snapshot-covered entries are skipped by rv
+        torn = False
         for name in (WAL_OLD, WAL):
             path = os.path.join(self._dir, name)
             if not os.path.exists(path):
                 continue
             with open(path, encoding="utf-8") as f:
-                for line in f:
+                if torn:
+                    # a tear in the earlier segment: entries here are
+                    # rv-later than the gap — applying them would fabricate
+                    # history across the hole
+                    self.dropped_entries += sum(1 for _ in f)
+                    continue
+                for lineno, line in enumerate(f, start=1):
                     try:
                         e = json.loads(line)
                         t, k, rv, obj = e["t"], e["k"], e["rv"], e["o"]
                     except (json.JSONDecodeError, KeyError):
-                        break  # torn tail from a crash mid-append
+                        # a crash mid-append tears the line it was writing;
+                        # recovery stops AT the tear and says how much it
+                        # dropped — a mid-file tear (bit rot, concurrent
+                        # writer bug) must never truncate history silently
+                        torn = True
+                        self.dropped_entries += 1 + sum(1 for _ in f)
+                        _log.warning(
+                            "%s torn at line %d; dropped %d entr%s after "
+                            "the tear (recovered rv=%d)",
+                            path, lineno, self.dropped_entries,
+                            "y" if self.dropped_entries == 1 else "ies",
+                            self._rv)
+                        break
                     if rv <= self._rv:
                         continue  # already folded into the snapshot
                     if t == DELETED:
@@ -120,7 +157,7 @@ class DurableStore(MemStore):
             os.fsync(self._wal.fileno())
         self._ops_since_snapshot += 1
         if (self._ops_since_snapshot >= self._snapshot_every
-                and not self._snapshotting):
+                and not self._snapshotting and not self._closed):
             # rotate under the lock (cheap), compact on a background thread
             # — a full-store JSON dump must never stall the request path
             self._snapshotting = True
@@ -131,9 +168,11 @@ class DurableStore(MemStore):
                 snap_rv, snap_data = self._rv, dict(self._data)
             else:
                 snap_rv, snap_data = self._rotate_wal_locked()
-            threading.Thread(
+            t = threading.Thread(
                 target=self._compact, args=(snap_rv, snap_data),
-                name="store-snapshot", daemon=True).start()
+                name="store-snapshot", daemon=True)
+            self._snapshot_thread = t
+            t.start()
         super()._publish(ev)
 
     # --- snapshot / compaction ----------------------------------------------------
@@ -151,6 +190,13 @@ class DurableStore(MemStore):
 
     def _compact(self, snap_rv: int, snap_data: dict):
         try:
+            # make the WAL rotation rename durable FIRST: until the
+            # directory entry hits disk, a crash could leave the old inode
+            # still named wal.log while the snapshot below supersedes it —
+            # recovery would then see segments in an order that never
+            # existed. (Runs off-lock by construction: compaction is a
+            # background/synchronous fold, never inside the store lock.)
+            fsync_dir(self._dir)
             snap = {"rv": snap_rv,
                     "data": {k: [obj, rv] for k, (obj, rv) in
                              snap_data.items()}}
@@ -165,6 +211,10 @@ class DurableStore(MemStore):
                 os.remove(os.path.join(self._dir, WAL_OLD))
             except FileNotFoundError:
                 pass
+            # ... and the replace+remove pair must be durable as a unit:
+            # without this fsync a crash here can resurrect wal.log.1 next
+            # to the NEW snapshot, re-ordering recovery's segment replay
+            fsync_dir(self._dir)
         except Exception:
             # disk-full etc: data stays safe (segments remain), the next
             # threshold retries via the salvage path — but say so loudly
@@ -178,6 +228,12 @@ class DurableStore(MemStore):
         on the calling thread; salvages a failed prior compaction's segment
         the same way the threshold path does."""
         with self._lock:
+            if self._closed:
+                # rotating would reopen the WAL handle close() just shut;
+                # the final state is already durable (close drained it)
+                _log.warning("snapshot() on closed store %s: no-op",
+                             self._dir)
+                return
             if self._snapshotting:
                 return
             self._snapshotting = True
@@ -189,6 +245,16 @@ class DurableStore(MemStore):
         self._compact(snap_rv, snap_data)
 
     def close(self):
+        # flag first (stops new compactions spawning), then drain any
+        # in-flight background compaction OUTSIDE the lock (the compactor
+        # never takes the store lock, but join can outlast a slow disk and
+        # must not stall readers) — an abandoned compactor racing close()
+        # otherwise deletes/renames files under a store shutting down
+        with self._lock:
+            self._closed = True
+            t = self._snapshot_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=30)
         with self._lock:
             try:
                 self._wal.flush()

@@ -1,0 +1,719 @@
+"""Quorum replication over the durable store: the control plane's HA floor.
+
+The reference delegates all of this to etcd's raft layer
+(pkg/storage/etcd/etcd_helper.go) — a write is acknowledged only once a
+quorum of members has it on disk, a leader crash promotes the most
+up-to-date survivor, and a restarted member catches up from snapshot +
+log tail. In-process we own that layer (ROADMAP item 4):
+
+  StoreMember        one replica: a term-stamped durable log (WAL +
+                     snapshot, same on-disk idiom as DurableStore) plus the
+                     applied key/value state. Members never serve clients.
+  ReplicationGroup   election + quorum commit. All member RPCs flow through
+                     one transport serialized by the ship gate; a commit is
+                     append -> quorum ack (durable on >= 2 of 3) -> done.
+  ReplicatedStore    the MemStore-compatible facade every apiserver's
+                     Registry shares. Writes stage under the store lock,
+                     replicate OUTSIDE it, and publish watch events only
+                     after the quorum ack — an event a watcher has seen is
+                     by construction on a majority of disks.
+
+Semantics preserved exactly (the acceptance contract): one monotonically
+increasing resourceVersion, CAS `update(expect_rv)` /
+`guaranteed_update`, bounded watch window with 410 — the existing store
+tests run parameterized over MemStore/DurableStore/ReplicatedStore.
+
+Safety argument (raft §5.4.1, scoped to the in-process model): the facade
+serializes all writes, so member logs are a prefix/overlap of one
+sequence — the only divergence source is a partial ship (a member died
+mid-round). An acked entry is on >= quorum members; election requires
+votes from >= quorum members, each granting only to a candidate whose
+log is at least as up-to-date — the intersection forces every acked
+entry into the new leader. A commit that could NOT reach quorum leaves
+its entry "stuck": the facade never re-stages that resourceVersion with
+different content (which would fork the log); the stuck entry is rolled
+forward — re-shipped until it commits — before any later write is
+accepted, surfacing NoQuorum (HTTP 503) to clients meanwhile.
+
+What this deliberately does not model: network partitions BETWEEN group
+coordinators (there is one group object per process — the fabric itself
+cannot split-brain). The chaos surface is member crash/restart at any
+pipeline stage, which is what the leader_kill soak scenario and the
+crash-recovery matrix in tests/test_replicated.py drive.
+
+Lock/IO discipline (policed by kube-verify's `replication-lock-io`
+checker): no transport send and no fsync ever runs while holding a store
+or member lock. Locks cover staging and state application only; the
+round-trip happens holding the commit gate (facade) and ship gate
+(group) — writer batons that readers and watchers never touch.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.storage.durable import SNAPSHOT, WAL, fsync_dir
+from kubernetes_tpu.storage.store import (
+    ADDED, DELETED, MODIFIED, Conflict, Event, KeyExists, KeyNotFound,
+    MemStore, StorageError, _copy,
+)
+from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+
+_log = logging.getLogger("storage.replicated")
+
+
+class NoQuorum(StorageError):
+    """The write could not reach a durable majority. Outcome UNKNOWN: the
+    entry may sit on a minority log and commit later (clients treat this
+    like any timeout — re-read, then retry)."""
+
+
+class MemberDown(StorageError):
+    """Transport-level: the target replica is not serving."""
+
+
+class LoopbackTransport:
+    """In-process member RPC fabric with chaos hooks. `before_send(method,
+    member)` runs before every delivery and may kill members or raise — the
+    crash-matrix tests inject faults here, the soak kills members directly."""
+
+    def __init__(self):
+        self.before_send = None
+
+    def call(self, member: "StoreMember", method: str, *args):
+        hook = self.before_send
+        if hook is not None:
+            hook(method, member)
+        if not member.alive:
+            raise MemberDown(member.id)
+        return getattr(member, method)(*args)
+
+
+class StoreMember:
+    """One storage replica: term-stamped durable log + applied state.
+
+    Disk layout mirrors DurableStore (snapshot.json + wal.log); every WAL
+    line additionally carries the entry's term (`m`). Members are written
+    to only through the group (whose ship gate serializes all RPCs), so
+    log lines never interleave even though the WAL write + fsync happen
+    outside the member lock — the structural rotate-under-lock /
+    ship-outside-lock split the replication-lock-io checker enforces."""
+
+    def __init__(self, member_id: str, data_dir: str, fsync: bool = False,
+                 snapshot_every: int = 10000):
+        self.id = member_id
+        self._dir = data_dir
+        self._fsync = fsync
+        self._snapshot_every = snapshot_every
+        self._lock = threading.RLock()
+        self._data: Dict[str, Tuple[dict, int]] = {}
+        self._rv = 0                 # rv of the last applied entry
+        self.term = 1                # highest term seen
+        self.last_entry_term = 0     # term of the entry at self._rv
+        self._voted_term = 0         # highest term this member voted in
+        self._snap_rv = 0            # rv covered by the on-disk snapshot
+        self._ops_since_snapshot = 0
+        self.alive = True
+        self.replayed = 0
+        self.dropped_entries = 0
+        os.makedirs(data_dir, exist_ok=True)
+        self._recover()
+        self._wal = open(os.path.join(data_dir, WAL), "a", encoding="utf-8")
+
+    # --- recovery / restart ---------------------------------------------------
+
+    def _recover(self) -> None:
+        snap_path = os.path.join(self._dir, SNAPSHOT)
+        if os.path.exists(snap_path):
+            with open(snap_path, encoding="utf-8") as f:
+                snap = json.load(f)
+            self._rv = self._snap_rv = snap["rv"]
+            self.term = max(self.term, snap.get("term", 1))
+            self.last_entry_term = snap.get("entry_term", 0)
+            self._data = {k: (obj, rv) for k, (obj, rv) in
+                          snap["data"].items()}
+        path = os.path.join(self._dir, WAL)
+        if not os.path.exists(path):
+            return
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, start=1):
+                try:
+                    e = json.loads(line)
+                    t, k, rv = e["t"], e["k"], e["rv"]
+                    obj, term = e["o"], e["m"]
+                except (json.JSONDecodeError, KeyError):
+                    # same contract as DurableStore: stop at the tear, say
+                    # how much was dropped — never truncate silently
+                    self.dropped_entries = 1 + sum(1 for _ in f)
+                    _log.warning(
+                        "member %s: %s torn at line %d; dropped %d "
+                        "entr%s after the tear (recovered rv=%d)",
+                        self.id, path, lineno, self.dropped_entries,
+                        "y" if self.dropped_entries == 1 else "ies",
+                        self._rv)
+                    break
+                if rv <= self._snap_rv:
+                    continue  # folded into the snapshot already
+                # last-wins per rv: a superseded slot (leader overwrite of
+                # an orphan) appears as a later line for the same rv
+                if t == DELETED:
+                    self._data.pop(k, None)
+                else:
+                    self._data[k] = (obj, rv)
+                self._rv = max(self._rv, rv)
+                self.last_entry_term = term
+                self.term = max(self.term, term)
+                self.replayed += 1
+
+    def restart(self) -> None:
+        """Crash-restart: rebuild from disk alone (in-memory state is gone),
+        then the group catches this member up before it serves votes."""
+        with self._lock:
+            self._data = {}
+            self._rv = self._snap_rv = 0
+            self.last_entry_term = 0
+            self.replayed = 0
+            self.dropped_entries = 0
+            self._ops_since_snapshot = 0
+        self._recover()
+        self._wal = open(os.path.join(self._dir, WAL), "a", encoding="utf-8")
+        with self._lock:
+            self.alive = True
+
+    def kill(self) -> None:
+        """Simulated crash: stop serving; the WAL handle dies with us. Every
+        acked append was already flushed (the ack IS the durability), so
+        nothing acknowledged is lost."""
+        with self._lock:
+            self.alive = False
+        try:
+            self._wal.close()
+        except (OSError, ValueError):
+            pass
+
+    def close(self) -> None:
+        self.kill()
+
+    # --- RPCs (reached only through the group's serialized transport) ---------
+
+    def append_entries(self, term: int, entries: List[dict]) -> bool:
+        """Durable log append + apply. Ack (True) means the entries are on
+        this member's disk. Stage under the lock, write the log OUTSIDE it,
+        apply under the lock."""
+        with self._lock:
+            if term < self.term:
+                return False  # stale leader
+            self.term = term
+            fresh = [e for e in entries if e["rv"] > self._rv]
+        if fresh:
+            for e in fresh:
+                self._wal.write(json.dumps(
+                    {"m": e["m"], "t": e["t"], "k": e["k"],
+                     "rv": e["rv"], "o": e["o"]},
+                    separators=(",", ":")) + "\n")
+            self._wal.flush()
+            if self._fsync:
+                os.fsync(self._wal.fileno())
+        with self._lock:
+            for e in fresh:
+                if e["t"] == DELETED:
+                    self._data.pop(e["k"], None)
+                else:
+                    self._data[e["k"]] = (e["o"], e["rv"])
+                self._rv = e["rv"]
+                self.last_entry_term = e["m"]
+            self._ops_since_snapshot += len(fresh)
+            needs_compact = self._ops_since_snapshot >= self._snapshot_every
+        if needs_compact:
+            self._compact()
+        return True
+
+    def request_vote(self, term: int, last_rv: int, last_term: int) -> bool:
+        """Grant iff we have not voted in this term and the candidate's log
+        is at least as up-to-date as ours (raft §5.4.1 — the rule that
+        forces every quorum-acked entry into the next leader)."""
+        with self._lock:
+            if term <= self._voted_term or term < self.term:
+                return False
+            if (last_term, last_rv) < (self.last_entry_term, self._rv):
+                return False
+            self._voted_term = term
+            self.term = max(self.term, term)
+            return True
+
+    def install_snapshot(self, term: int, rv: int, data: Dict[str, tuple],
+                         entry_term: int) -> bool:
+        """Full state transfer (catch-up fallback when the WAL tail was
+        compacted away, or to truncate a divergent minority tail). Durable
+        snapshot write happens outside the lock."""
+        with self._lock:
+            if term < self.term:
+                return False
+            self.term = term
+        self._write_snapshot(rv, entry_term, dict(data))
+        with self._lock:
+            self._data = dict(data)
+            self._rv = self._snap_rv = rv
+            self.last_entry_term = entry_term
+            self._ops_since_snapshot = 0
+        return True
+
+    # --- catch-up source (leader side) ----------------------------------------
+
+    def read_log_tail(self, since_rv: int) -> Optional[List[dict]]:
+        """Entries with rv > since_rv from the on-disk log, dedup'd last-wins
+        per rv — the cheap catch-up path. None when the tail was compacted
+        past since_rv (the caller falls back to install_snapshot)."""
+        if since_rv < self._snap_rv:
+            return None
+        by_rv: Dict[int, dict] = {}
+        path = os.path.join(self._dir, WAL)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        e = json.loads(line)
+                    except json.JSONDecodeError:
+                        break
+                    if e.get("rv", 0) > since_rv:
+                        by_rv[e["rv"]] = {"m": e["m"], "t": e["t"],
+                                          "k": e["k"], "rv": e["rv"],
+                                          "o": e["o"]}
+        tail = [by_rv[rv] for rv in sorted(by_rv)]
+        # contiguity: a hole means the log cannot replay cleanly from
+        # since_rv — force the snapshot path rather than fabricate history
+        expect = since_rv + 1
+        for e in tail:
+            if e["rv"] != expect:
+                return None
+            expect += 1
+        if expect <= self._rv:
+            return None  # log ends short of the applied state
+        return tail
+
+    # --- compaction -----------------------------------------------------------
+
+    def _compact(self) -> None:
+        """Fold the log into the snapshot. Runs inside an append RPC (the
+        ship gate serializes all appends) but outside the member lock."""
+        with self._lock:
+            snap_rv, entry_term = self._rv, self.last_entry_term
+            snap_data = dict(self._data)
+            self._ops_since_snapshot = 0
+        try:
+            self._write_snapshot(snap_rv, entry_term, snap_data)
+        except OSError:
+            _log.exception("member %s: compaction failed; WAL keeps "
+                           "growing until a retry succeeds", self.id)
+            return
+        with self._lock:
+            self._snap_rv = snap_rv
+
+    def _write_snapshot(self, rv: int, entry_term: int,
+                        data: Dict[str, tuple]) -> None:
+        snap = {"rv": rv, "term": self.term, "entry_term": entry_term,
+                "data": {k: [obj, irv] for k, (obj, irv) in data.items()}}
+        tmp = os.path.join(self._dir, SNAPSHOT + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snap, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._dir, SNAPSHOT))
+        fsync_dir(self._dir)
+        # snapshot durable: the log it folded is redundant — truncate
+        try:
+            self._wal.close()
+        except (OSError, ValueError):
+            pass
+        self._wal = open(os.path.join(self._dir, WAL), "w", encoding="utf-8")
+        fsync_dir(self._dir)
+
+    # --- introspection --------------------------------------------------------
+
+    def last_log_pos(self) -> Tuple[int, int]:
+        with self._lock:
+            return (self.last_entry_term, self._rv)
+
+    def state_digest(self) -> Tuple[int, str]:
+        """(rv, stable content hash) — the convergence check the chaos soak
+        and the crash matrix assert on."""
+        import hashlib
+        with self._lock:
+            blob = json.dumps(sorted(self._data.items()),
+                              separators=(",", ":"), sort_keys=True)
+            return (self._rv,
+                    hashlib.sha1(blob.encode()).hexdigest()[:16])
+
+    def committed_state(self) -> Tuple[int, Dict[str, tuple], int]:
+        with self._lock:
+            return self._rv, dict(self._data), self.last_entry_term
+
+
+class ReplicationGroup:
+    """Election + quorum commit. One group object per process: it IS the
+    members' communication fabric, so chaos means member crashes (any
+    pipeline stage), not fabric partitions."""
+
+    def __init__(self, members: List[StoreMember],
+                 heartbeat_period: float = 0.0,
+                 quorum_deadline: float = 5.0,
+                 transport: Optional[LoopbackTransport] = None):
+        if len(members) < 3:
+            raise ValueError("quorum replication needs >= 3 members")
+        self.members = list(members)
+        self.transport = transport or LoopbackTransport()
+        self.quorum = len(members) // 2 + 1
+        self.quorum_deadline = quorum_deadline
+        self._meta = threading.Lock()       # term/leader bookkeeping only
+        self._ship_gate = threading.Lock()  # serializes ALL member RPCs
+        self.term = max(m.term for m in members)
+        self.leader_id: Optional[str] = None
+        self.leader_transitions = 0
+        self.failovers: List[float] = []    # detection -> new leader, seconds
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        with self._ship_gate:
+            self._elect(time.monotonic())
+        if heartbeat_period > 0:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, args=(heartbeat_period,),
+                name="replication-monitor", daemon=True)
+            self._monitor.start()
+
+    # --- leader bookkeeping ---------------------------------------------------
+
+    def leader(self) -> Optional[StoreMember]:
+        with self._meta:
+            lid = self.leader_id
+        for m in self.members:
+            if m.id == lid:
+                return m
+        return None
+
+    def member(self, member_id: str) -> StoreMember:
+        for m in self.members:
+            if m.id == member_id:
+                return m
+        raise KeyError(member_id)
+
+    def alive_members(self) -> List[StoreMember]:
+        return [m for m in self.members if m.alive]
+
+    def committed_state(self) -> Tuple[int, Dict[str, tuple], int]:
+        lead = self.leader()
+        if lead is None:
+            raise NoQuorum("no leader")
+        return lead.committed_state()
+
+    def converged(self) -> bool:
+        digests = {m.state_digest() for m in self.alive_members()}
+        return len(digests) == 1
+
+    # --- the commit pipeline --------------------------------------------------
+
+    def commit(self, entry: dict) -> None:
+        """Drive one entry to a durable quorum; raises NoQuorum after the
+        deadline. Leader death mid-round triggers an election and a re-ship
+        — callers above surface that as latency, never as data loss."""
+        deadline = time.monotonic() + self.quorum_deadline
+        with self._ship_gate:
+            while True:
+                lead = self._leader_or_elect()
+                if lead is not None:
+                    with self._meta:
+                        entry["m"] = self.term
+                    acks = 0
+                    leader_ok = self._append(lead, [entry])
+                    acks += int(leader_ok)
+                    for m in self.members:
+                        if m is lead or not m.alive:
+                            continue
+                        acks += int(self._append(m, [entry]))
+                    if leader_ok and acks >= self.quorum:
+                        METRICS.inc("storage_quorum_commits_total",
+                                    result="ok")
+                        return
+                if time.monotonic() >= deadline:
+                    METRICS.inc("storage_quorum_commits_total",
+                                result="noquorum")
+                    raise NoQuorum(
+                        f"entry rv={entry.get('rv')} reached no durable "
+                        f"majority within {self.quorum_deadline:g}s")
+                time.sleep(0.02)
+
+    def _append(self, m: StoreMember, entries: List[dict]) -> bool:
+        try:
+            return bool(self.transport.call(m, "append_entries",
+                                            self.term, entries))
+        except (MemberDown, OSError, ValueError):
+            return False
+
+    def _leader_or_elect(self) -> Optional[StoreMember]:
+        """Caller holds the ship gate. Returns a live leader, electing one
+        if the current leader is dead; None if election failed (retry until
+        the caller's deadline)."""
+        lead = self.leader()
+        if lead is not None and lead.alive:
+            return lead
+        try:
+            self._elect(time.monotonic())
+        except NoQuorum:
+            return None
+        return self.leader()
+
+    # --- election -------------------------------------------------------------
+
+    def _elect(self, t_detect: float) -> None:
+        """Caller holds the ship gate. Raft-shaped: bump the term, the most
+        up-to-date live member stands, a quorum of votes installs it, then
+        followers are reconciled to its log."""
+        alive = self.alive_members()
+        if len(alive) < self.quorum:
+            raise NoQuorum(f"{len(alive)}/{len(self.members)} members "
+                           f"alive; quorum is {self.quorum}")
+        with self._meta:
+            self.term += 1
+            term = self.term
+        cand = max(alive, key=lambda m: m.last_log_pos())
+        last_term, last_rv = cand.last_log_pos()
+        votes = 0
+        for m in alive:
+            try:
+                votes += int(self.transport.call(
+                    m, "request_vote", term, last_rv, last_term))
+            except (MemberDown, OSError):
+                pass
+        if votes < self.quorum:
+            raise NoQuorum(f"election term {term}: {votes} votes "
+                           f"< quorum {self.quorum}")
+        with self._meta:
+            prev = self.leader_id
+            self.leader_id = cand.id
+        for m in alive:
+            if m is not cand:
+                self._catch_up_member(m, cand)
+        if prev is not None and prev != cand.id:
+            self.leader_transitions += 1
+            took = time.monotonic() - t_detect
+            self.failovers.append(took)
+            METRICS.inc("storage_leader_transitions_total")
+            METRICS.observe("storage_failover_seconds", took)
+            _log.warning("storage leader failover: %s -> %s (term %d, "
+                         "%.3fs)", prev, cand.id, term, took)
+
+    # --- catch-up -------------------------------------------------------------
+
+    def _catch_up_member(self, m: StoreMember, lead: StoreMember) -> None:
+        """Caller holds the ship gate. Snapshot + WAL tail when the leader's
+        log still covers the gap; full snapshot otherwise (also the path
+        that truncates a divergent minority tail)."""
+        m_term, m_rv = m.last_log_pos()
+        l_term, l_rv = lead.last_log_pos()
+        mode = "snapshot"
+        if m_rv <= l_rv and (m_term, m_rv) <= (l_term, l_rv):
+            tail = lead.read_log_tail(m_rv)
+            if tail is not None:
+                if tail:
+                    try:
+                        if self.transport.call(m, "append_entries",
+                                               self.term, tail):
+                            mode = "tail"
+                    except (MemberDown, OSError):
+                        return
+                else:
+                    mode = "tail"  # already level
+        if mode == "snapshot":
+            rv, data, entry_term = lead.committed_state()
+            try:
+                self.transport.call(m, "install_snapshot", self.term, rv,
+                                    data, entry_term)
+            except (MemberDown, OSError):
+                return
+        METRICS.inc("storage_member_catchup_total", mode=mode)
+
+    # --- chaos / lifecycle ----------------------------------------------------
+
+    def kill_member(self, member_id: str) -> None:
+        self.member(member_id).kill()
+
+    def kill_leader(self) -> Optional[str]:
+        lead = self.leader()
+        if lead is None:
+            return None
+        lead.kill()
+        return lead.id
+
+    def restart_member(self, member_id: str) -> None:
+        """Crash-recover a member from its disk and catch it up from the
+        current leader — the rejoin path the crash matrix exercises."""
+        m = self.member(member_id)
+        m.restart()
+        with self._ship_gate:
+            lead = self._leader_or_elect()
+            if lead is not None and lead is not m:
+                self._catch_up_member(m, lead)
+
+    def heartbeat(self) -> bool:
+        """One monitor tick: ping the leader (empty append); a dead leader
+        triggers an election. Returns True when a live leader exists."""
+        t0 = time.monotonic()
+        with self._ship_gate:
+            lead = self.leader()
+            if lead is not None and lead.alive and self._append(lead, []):
+                return True
+            try:
+                self._elect(t0)
+            except NoQuorum:
+                return False
+            return True
+
+    def _monitor_loop(self, period: float) -> None:
+        while not self._stop.wait(period):
+            try:
+                self.heartbeat()
+            except Exception:
+                _log.exception("replication monitor tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+
+
+class ReplicatedStore(MemStore):
+    """MemStore-compatible facade over a ReplicationGroup. Drop-in for
+    Registry(store=...); every apiserver in the process shares ONE facade,
+    exactly as every reference apiserver shares one etcd cluster."""
+
+    def __init__(self, group: ReplicationGroup, window: int = 4096,
+                 watcher_queue: int = 4096):
+        super().__init__(window=window, watcher_queue=watcher_queue)
+        self._group = group
+        rv, data, _term = group.committed_state()
+        self._rv = rv
+        self._data = data
+        # the writer baton: serializes stage -> replicate -> publish.
+        # Readers/watchers never touch it — they see the store lock only,
+        # which is never held across the replication round-trip.
+        self._commit_gate = threading.Lock()
+        # a NoQuorum'd entry: its rv slot is burned (restaging it with
+        # different content would fork member logs); it must commit before
+        # any later write is accepted
+        self._stuck: Optional[Tuple[dict, Optional[dict]]] = None
+
+    @property
+    def group(self) -> ReplicationGroup:
+        return self._group
+
+    @classmethod
+    def local(cls, base_dir: str, n: int = 3, fsync: bool = False,
+              heartbeat_period: float = 0.0, window: int = 4096,
+              watcher_queue: int = 4096, snapshot_every: int = 10000,
+              quorum_deadline: float = 5.0) -> "ReplicatedStore":
+        """A 3-member (by default) replicated store rooted at base_dir —
+        the constructor the soak harness, the smoke, and tests share."""
+        members = [StoreMember(f"m{i}", os.path.join(base_dir, f"member-{i}"),
+                               fsync=fsync, snapshot_every=snapshot_every)
+                   for i in range(n)]
+        group = ReplicationGroup(members, heartbeat_period=heartbeat_period,
+                                 quorum_deadline=quorum_deadline)
+        return cls(group, window=window, watcher_queue=watcher_queue)
+
+    # --- write pipeline -------------------------------------------------------
+
+    def create(self, key: str, obj: dict) -> int:
+        with self._commit_gate:
+            self._roll_forward()
+            with self._lock:
+                if key in self._data:
+                    raise KeyExists(key)
+                obj = _copy(obj)
+                entry = {"t": ADDED, "k": key, "rv": self._rv + 1, "o": obj}
+            self._replicate(entry, None)
+            return self._apply_committed(entry, None)
+
+    def update(self, key: str, obj: dict,
+               expect_rv: Optional[int] = None) -> int:
+        with self._commit_gate:
+            self._roll_forward()
+            with self._lock:
+                if key not in self._data:
+                    raise KeyNotFound(key)
+                prev, cur_rv = self._data[key]
+                if expect_rv is not None and expect_rv != cur_rv:
+                    raise Conflict(f"{key}: rv {expect_rv} != current "
+                                   f"{cur_rv}")
+                obj = _copy(obj)
+                entry = {"t": MODIFIED, "k": key, "rv": self._rv + 1,
+                         "o": obj}
+            self._replicate(entry, prev)
+            return self._apply_committed(entry, prev)
+
+    def delete(self, key: str,
+               expect_rv: Optional[int] = None) -> Tuple[dict, int]:
+        with self._commit_gate:
+            self._roll_forward()
+            with self._lock:
+                if key not in self._data:
+                    raise KeyNotFound(key)
+                obj, cur_rv = self._data[key]
+                if expect_rv is not None and expect_rv != cur_rv:
+                    raise Conflict(f"{key}: rv {expect_rv} != current "
+                                   f"{cur_rv}")
+                entry = {"t": DELETED, "k": key, "rv": self._rv + 1,
+                         "o": obj}
+            self._replicate(entry, obj)
+            self._apply_committed(entry, obj)
+            return _copy(entry["o"]), entry["rv"]
+
+    # guaranteed_update is inherited unchanged: its get/update(expect_rv)
+    # loop IS the CAS contract, and a leader change mid-loop surfaces as
+    # the Conflict/retry path clients already speak.
+
+    def _roll_forward(self) -> None:
+        """Caller holds the commit gate: drive any stuck entry to quorum
+        before staging new work (its effects must be visible to the next
+        write's preconditions)."""
+        if self._stuck is None:
+            return
+        entry, prev = self._stuck
+        self._group.commit(entry)  # NoQuorum propagates; stays stuck
+        self._apply_committed(entry, prev)
+        self._stuck = None
+
+    def _replicate(self, entry: dict, prev: Optional[dict]) -> None:
+        try:
+            self._group.commit(entry)
+        except NoQuorum:
+            self._stuck = (entry, prev)
+            raise
+
+    def _apply_committed(self, entry: dict, prev: Optional[dict]) -> int:
+        """Quorum reached: apply to the serving state and publish the watch
+        event — the first moment any observer may see this write."""
+        t, k, rv, obj = entry["t"], entry["k"], entry["rv"], entry["o"]
+        with self._lock:
+            if t == DELETED:
+                self._data.pop(k, None)
+            else:
+                self._data[k] = (obj, rv)
+            self._rv = rv
+            self._publish(Event(t, k, rv, _copy(obj),
+                                prev_obj=prev if t != ADDED else None))
+        return rv
+
+    # --- lifecycle ------------------------------------------------------------
+
+    def snapshot(self) -> None:
+        """Durability is the members' concern; their logs fold on their own
+        cadence. Kept for DurableStore API compatibility."""
+
+    def close(self) -> None:
+        self._group.stop()
+        for m in self._group.members:
+            m.close()

@@ -135,6 +135,7 @@ class DebugServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True  # see utils/nethost.py
 
             def log_message(self, fmt, *args):
                 pass

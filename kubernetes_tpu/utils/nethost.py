@@ -1,10 +1,40 @@
 """host:port parsing shared by everything that dials a configured address
 (federation member clients, cluster health probes, the discovery proxy).
-One tolerant parse instead of three divergent hand-rolled ones."""
+One tolerant parse instead of three divergent hand-rolled ones.
+
+Also the TCP_NODELAY connection classes every in-repo HTTP hop uses: the
+stdlib leaves Nagle ON, and a small POST (headers then body in separate
+segments) against a delayed-ACK peer costs a flat ~40 ms per request —
+a 20x request-rate floor that made the chaos soak's churn back up behind
+the kill. The reference's Go net/http sets TCP_NODELAY on every conn by
+default; these classes are that default for http.client, and the HTTP
+servers set disable_nagle_algorithm for the other direction."""
 
 from __future__ import annotations
 
+import http.client
+import socket
 from typing import Tuple
+
+
+def set_nodelay(sock) -> None:
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except (OSError, AttributeError):
+        pass  # non-TCP transports (unix sockets, mocks) simply don't care
+
+
+class NoDelayHTTPConnection(http.client.HTTPConnection):
+    def connect(self):
+        super().connect()
+        set_nodelay(self.sock)
+
+
+class NoDelayHTTPSConnection(http.client.HTTPSConnection):
+    def connect(self):
+        super().connect()
+        # SSLSocket proxies setsockopt to the wrapped TCP socket
+        set_nodelay(self.sock)
 
 
 def parse_host_port(address: str, default_port: int = 8080) -> Tuple[str, int]:

@@ -103,6 +103,58 @@ class TestRecovery:
         assert s.get("/k/x")[0] == {"n": 1}
         s.close()
 
+    def test_torn_mid_file_stops_at_tear_and_logs_drop_count(
+            self, tmp_path, caplog):
+        """A tear in the MIDDLE of the WAL (bit rot, torn sector) must stop
+        recovery at the tear — applying later entries would fabricate
+        history across the hole — and must say how much it dropped, never
+        truncate silently."""
+        import os
+        d = str(tmp_path)
+        s = DurableStore(d)
+        for i in range(4):
+            s.create(f"/k/{i}", {"i": i})
+        s.close()
+        path = os.path.join(d, "wal.log")
+        lines = open(path).read().splitlines()
+        lines[1] = lines[1][: len(lines[1]) // 2]  # tear entry #2
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with caplog.at_level("WARNING", logger="storage.durable"):
+            r = DurableStore(d)
+        assert r.current_rv == 1          # stopped AT the tear
+        assert r.count("/k/") == 1
+        assert r.dropped_entries == 3     # the torn line + 2 good ones after
+        assert any("dropped 3 entries" in rec.getMessage()
+                   for rec in caplog.records)
+        r.close()
+
+    def test_close_drains_background_compaction(self, tmp_path):
+        """close() must join an in-flight compaction thread instead of
+        racing it over the files, and a compaction must never spawn after
+        the store is flagged closed."""
+        s = DurableStore(str(tmp_path), snapshot_every=10)
+        for i in range(35):  # several threshold crossings
+            s.create(f"/k/{i:02d}", {"i": i})
+        s.close()
+        t = s._snapshot_thread
+        assert t is None or not t.is_alive()
+        # the data survived whatever compaction state close() drained
+        r = DurableStore(str(tmp_path))
+        assert r.count("/k/") == 35
+        r.close()
+
+    def test_snapshot_after_close_is_logged_noop(self, tmp_path, caplog):
+        s = DurableStore(str(tmp_path))
+        s.create("/k/a", {"v": 1})
+        s.close()
+        with caplog.at_level("WARNING", logger="storage.durable"):
+            s.snapshot()  # must not raise ValueError from the dead handle
+        assert any("no-op" in rec.getMessage() for rec in caplog.records)
+        r = DurableStore(str(tmp_path))
+        assert r.get("/k/a")[0] == {"v": 1}
+        r.close()
+
 
 def mk_pod(name):
     return api.Pod(

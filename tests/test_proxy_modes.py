@@ -150,6 +150,20 @@ def _dial(port: int) -> bytes:
             out += b
 
 
+def _wait_active(port: int, timeout: float = 10.0) -> None:
+    """Wait until the relay has a live backend. The service port opens on
+    the SERVICE event, endpoints are programmed by a separate event, and a
+    dial in between is rightly dropped (b"") — reference userspace-proxy
+    bootstrap behavior, which the fast (TCP_NODELAY) stack now actually
+    exposes to tests."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _dial(port):
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"relay on :{port} never served a backend")
+
+
 class TestLoadBalancerRR:
     def test_round_robin(self):
         lb = LoadBalancerRR()
@@ -208,6 +222,7 @@ class TestUserspaceProxier:
                     "default/web:main" not in p.port_map:
                 time.sleep(0.05)
             lport = p.port_map["default/web:main"]
+            _wait_active(lport)
             seen = {_dial(lport) for _ in range(6)}
             assert seen == {b"one", b"two"}, f"no spread: {seen}"
         finally:
@@ -228,6 +243,7 @@ class TestUserspaceProxier:
                     "default/flip:main" not in p.port_map:
                 time.sleep(0.05)
             lport = p.port_map["default/flip:main"]
+            _wait_active(lport)
             assert _dial(lport) == b"old"
             ep = client.get("endpoints", "flip", "default")
             ep.subsets[0].ports[0].port = b2.port
@@ -262,6 +278,7 @@ class TestUserspaceProxier:
                     "default/pin:main" not in p.port_map:
                 time.sleep(0.05)
             lport = p.port_map["default/pin:main"]
+            _wait_active(lport)
             # all connections come from 127.0.0.1 -> one sticky backend
             seen = {_dial(lport) for _ in range(6)}
             assert len(seen) == 1, f"affinity did not pin: {seen}"

@@ -1,0 +1,400 @@
+"""Replicated control plane: quorum commits, election safety, and the
+crash-recovery matrix (ROADMAP item 4 / ISSUE 14).
+
+The matrix kills a member at each pipeline stage — pre-ack, post-ack/
+pre-publish, mid-snapshot, mid-catch-up — and asserts the rejoined member
+converges to the leader's state with no resourceVersion regressions. The
+meta-invariant everywhere: an event a watcher has SEEN is on a durable
+majority, so no single crash can un-happen it.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import RESTClient
+from kubernetes_tpu.client.leaderelection import (
+    LeaderElectionConfig, LeaderElector,
+)
+from kubernetes_tpu.discovery import DiscoveryProxy
+from kubernetes_tpu.registry.generic import Registry
+from kubernetes_tpu.storage import (
+    DurableStore, MemStore, NoQuorum, ReplicatedStore,
+)
+from kubernetes_tpu.storage.replicated import StoreMember
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = ReplicatedStore.local(str(tmp_path), quorum_deadline=1.0)
+    yield s
+    s.close()
+
+
+def digests(group):
+    return {m.id: m.state_digest() for m in group.members}
+
+
+def assert_converged(group):
+    ds = {m.state_digest() for m in group.alive_members()}
+    assert len(ds) == 1, f"members diverged: {digests(group)}"
+
+
+class TestQuorumCommit:
+    def test_write_is_on_a_majority_before_publish(self, store):
+        w = store.watch("/")
+        store.create("/k", {"v": 1})
+        ev = w.next(timeout=1)
+        w.stop()
+        # the event was published => the entry must already be durable on
+        # a quorum of member disks
+        on_disk = sum(
+            1 for m in store.group.members
+            if any(json.loads(line)["k"] == "/k"
+                   for line in open(os.path.join(m._dir, "wal.log")))
+        )
+        assert ev is not None and on_disk >= store.group.quorum
+
+    def test_leader_kill_preserves_acked_writes(self, store):
+        rvs = [store.create(f"/k/{i}", {"i": i}) for i in range(5)]
+        killed = store.group.kill_leader()
+        # every acked write survives into the new leader's state
+        rv6 = store.create("/k/after", {"i": 99})
+        assert rv6 == rvs[-1] + 1  # rv stays monotonic across failover
+        for i in range(5):
+            assert store.get(f"/k/{i}")[0] == {"i": i}
+        assert store.group.leader_id != killed
+        assert store.group.leader_transitions == 1
+        assert store.group.failovers  # the window was measured
+
+    def test_no_quorum_blocks_writes_then_rolls_forward(self, tmp_path):
+        s = ReplicatedStore.local(str(tmp_path), quorum_deadline=0.3)
+        try:
+            s.create("/k/committed", {"v": 1})
+            ids = [m.id for m in s.group.members]
+            for mid in ids[1:]:
+                s.group.kill_member(mid)
+            w = s.watch("/", since_rv=s.current_rv)
+            with pytest.raises(NoQuorum):
+                s.create("/k/stuck", {"v": 2})
+            # NOT published, NOT readable: no observer may see a write
+            # that never reached a majority
+            assert w.next(timeout=0.1) is None
+            with pytest.raises(Exception):
+                s.get("/k/stuck")
+            # quorum returns: the stuck entry must commit FIRST (its rv
+            # slot is burned), then new writes proceed in order
+            for mid in ids[1:]:
+                s.group.restart_member(mid)
+            rv = s.create("/k/next", {"v": 3})
+            e1, e2 = w.next(timeout=1), w.next(timeout=1)
+            assert (e1.key, e2.key) == ("/k/stuck", "/k/next")
+            assert e2.rv == rv and e1.rv == rv - 1
+            assert_converged(s.group)
+            w.stop()
+        finally:
+            s.close()
+
+
+class TestCrashRecoveryMatrix:
+    """Kill a member at each pipeline stage; the rejoined member must
+    converge with no rv regression."""
+
+    def _fill(self, store, n=8):
+        for i in range(n):
+            store.create(f"/k/{i}", {"i": i})
+
+    def test_kill_pre_ack(self, store):
+        group = store.group
+        victim = next(m for m in group.members
+                      if m.id != group.leader_id)
+
+        def kill_before_delivery(method, member):
+            if method == "append_entries" and member is victim \
+                    and victim.alive:
+                victim.kill()  # dies before it could ack
+
+        group.transport.before_send = kill_before_delivery
+        self._fill(store)  # quorum still reachable via the other follower
+        group.transport.before_send = None
+        rv_before = victim._rv
+        group.restart_member(victim.id)
+        assert victim._rv >= rv_before  # catch-up never regresses
+        assert victim._rv == group.leader()._rv
+        assert_converged(group)
+
+    def test_kill_post_ack_pre_publish(self, store):
+        group = store.group
+        seen = []
+        w = store.watch("/")
+        orig_apply = store._apply_committed
+        state = {"killed": None}
+
+        def kill_after_quorum(entry, prev):
+            # the entry IS durable on a quorum here; the publish has not
+            # happened yet — kill an acker, then publish anyway
+            if state["killed"] is None:
+                victim = next(m for m in group.members
+                              if m.id != group.leader_id)
+                victim.kill()
+                state["killed"] = victim
+            return orig_apply(entry, prev)
+
+        store._apply_committed = kill_after_quorum
+        rv = store.create("/k/x", {"v": 1})
+        store._apply_committed = orig_apply
+        ev = w.next(timeout=1)
+        assert ev is not None and ev.rv == rv  # published exactly once
+        assert w.next(timeout=0.1) is None
+        self._fill(store)  # keep writing on the surviving quorum
+        group.restart_member(state["killed"].id)
+        assert_converged(group)
+        assert state["killed"]._rv == group.leader()._rv
+        w.stop()
+        seen  # silence lint
+
+    def test_kill_mid_snapshot(self, store):
+        group = store.group
+        self._fill(store)
+        victim = next(m for m in group.members
+                      if m.id != group.leader_id)
+        victim.kill()
+        # the crash window: snapshot.tmp written, never renamed — and the
+        # WAL still holds everything (truncation follows the rename)
+        with open(os.path.join(victim._dir, "snapshot.json.tmp"),
+                  "w") as f:
+            f.write('{"rv": 999, "te')  # torn mid-serialize
+        self._fill_more(store)
+        group.restart_member(victim.id)
+        assert_converged(group)
+        assert victim._rv == group.leader()._rv
+
+    def _fill_more(self, store):
+        for i in range(8, 12):
+            store.create(f"/k/{i}", {"i": i})
+
+    def test_kill_mid_catch_up(self, store):
+        group = store.group
+        self._fill(store)
+        victim = next(m for m in group.members
+                      if m.id != group.leader_id)
+        victim.kill()
+        self._fill_more(store)  # victim now lags
+
+        calls = {"n": 0}
+
+        def kill_during_catchup(method, member):
+            if method in ("append_entries", "install_snapshot") \
+                    and member is victim and calls["n"] == 0:
+                calls["n"] += 1
+                victim.kill()  # dies again mid-catch-up
+
+        group.transport.before_send = kill_during_catchup
+        group.restart_member(victim.id)  # this catch-up is interrupted
+        group.transport.before_send = None
+        assert not victim.alive or victim._rv <= group.leader()._rv
+        group.restart_member(victim.id)  # second rejoin completes
+        assert_converged(group)
+        assert victim._rv == group.leader()._rv
+        assert calls["n"] == 1
+
+    def test_compacted_leader_serves_snapshot_catchup(self, tmp_path):
+        # the WAL-tail path is gone after compaction: catch-up must fall
+        # back to a full snapshot install, not fabricate a partial log
+        s = ReplicatedStore.local(str(tmp_path), snapshot_every=5,
+                                  quorum_deadline=1.0)
+        try:
+            group = s.group
+            victim = next(m for m in group.members
+                          if m.id != group.leader_id)
+            victim.kill()
+            for i in range(12):  # crosses members' snapshot threshold
+                s.create(f"/k/{i}", {"i": i})
+            lead = group.leader()
+            assert lead._snap_rv > 0  # the leader really compacted
+            assert lead.read_log_tail(0) is None  # tail unavailable
+            group.restart_member(victim.id)
+            assert_converged(group)
+        finally:
+            s.close()
+
+
+class TestMemberDurability:
+    def test_torn_mid_file_member_wal_stops_and_logs(self, tmp_path, caplog):
+        d = str(tmp_path / "m")
+        m = StoreMember("m0", d)
+        m.append_entries(1, [
+            {"m": 1, "t": "ADDED", "k": f"/k/{i}", "rv": i + 1,
+             "o": {"i": i}} for i in range(3)])
+        m.kill()
+        # tear the SECOND line and keep two good lines after it: recovery
+        # must stop at the tear (no fabricated history across the hole)
+        # and say how many entries it dropped
+        path = os.path.join(d, "wal.log")
+        lines = open(path).read().splitlines()
+        lines[1] = lines[1][: len(lines[1]) // 2]
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with caplog.at_level("WARNING", logger="storage.replicated"):
+            r = StoreMember("m0", d)
+        assert r._rv == 1  # stopped at the tear
+        assert r.dropped_entries == 2  # the torn line + the good one after
+        assert any("dropped 2 entries" in rec.getMessage()
+                   for rec in caplog.records)
+
+    def test_member_restart_replays_snapshot_plus_tail(self, tmp_path):
+        d = str(tmp_path / "m")
+        m = StoreMember("m0", d, snapshot_every=4)
+        for i in range(10):
+            m.append_entries(2, [{"m": 2, "t": "ADDED", "k": f"/k/{i}",
+                                  "rv": i + 1, "o": {"i": i}}])
+        assert m._snap_rv > 0
+        digest = m.state_digest()
+        m.kill()
+        r = StoreMember("m0", d)
+        assert r.state_digest() == digest
+        assert r.last_entry_term == 2
+
+
+class TestRegistryContracts:
+    """The typed layer above L0, parameterized over all three stores: the
+    bind CAS and the watch-410 contract must hold identically."""
+
+    @pytest.fixture(params=["mem", "durable", "replicated"])
+    def registry(self, request, tmp_path):
+        if request.param == "mem":
+            s = MemStore()
+        elif request.param == "durable":
+            s = DurableStore(str(tmp_path / "d"))
+        else:
+            s = ReplicatedStore.local(str(tmp_path / "r"))
+        yield Registry(s)
+        close = getattr(s, "close", None)
+        if close:
+            close()
+
+    def _pod(self, name):
+        return api.Pod(
+            metadata=api.ObjectMeta(name=name, namespace="default"),
+            spec=api.PodSpec(containers=[
+                api.Container(name="c", image="pause")]))
+
+    def test_bind_cas_and_watch(self, registry):
+        registry.create("pods", self._pod("p1"))
+        w = registry.watch("pods", "default", since_rv=0)
+        binding = api.Binding(
+            metadata=api.ObjectMeta(name="p1", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n1"))
+        registry.bind_pod(binding, "default")
+        got = registry.get("pods", "p1", "default")
+        assert got.spec.node_name == "n1"
+        # re-binding to a DIFFERENT node loses the CAS exactly like the
+        # reference (same-node re-bind is idempotent)
+        from kubernetes_tpu.registry.generic import RegistryError
+        binding2 = api.Binding(
+            metadata=api.ObjectMeta(name="p1", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n2"))
+        with pytest.raises(RegistryError):
+            registry.bind_pod(binding2, "default")
+        evs = [w.next(timeout=1), w.next(timeout=1)]
+        assert [e.type for e in evs] == ["ADDED", "MODIFIED"]
+        w.stop()
+
+
+class TestReplicatedApiserverE2E:
+    def test_two_apiservers_one_quorum_with_failover(self, tmp_path):
+        """Both apiservers serve one replicated store behind the proxy;
+        killing the primary apiserver AND the storage leader mid-traffic
+        loses nothing acknowledged."""
+        s = ReplicatedStore.local(str(tmp_path))
+        reg = Registry(s)
+        s1, s2 = APIServer(reg).start(), APIServer(reg).start()
+        proxy = DiscoveryProxy([f"127.0.0.1:{s1.port}",
+                                f"127.0.0.1:{s2.port}"]).start()
+        client = RESTClient(port=proxy.port, qps=1000, burst=1000)
+        try:
+            for i in range(5):
+                client.create("pods", api.Pod(
+                    metadata=api.ObjectMeta(name=f"p{i}",
+                                            namespace="default"),
+                    spec=api.PodSpec(containers=[
+                        api.Container(name="c", image="i")])))
+            s1.stop()
+            s.group.kill_leader()
+            # writes keep landing through the surviving apiserver + quorum
+            client.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name="after", namespace="default"),
+                spec=api.PodSpec(containers=[
+                    api.Container(name="c", image="i")])))
+            pods, _ = client.list("pods", "default")
+            assert len(pods) == 6
+            assert s.group.leader_transitions == 1
+        finally:
+            proxy.stop()
+            s1.stop()
+            s2.stop()
+            s.close()
+
+
+class TestLeaderKillSoak:
+    def test_chaos_soak_reports_failover_and_zero_lost_binds(self):
+        """The chaos scenario end to end at smoke scale: kill the storage
+        leader + the primary apiserver mid-churn; the report must carry a
+        recorded failover, zero lost acked bindings, member convergence,
+        and wedged=False."""
+        from kubernetes_tpu.observability.soak import SoakConfig, run_soak
+        cfg = SoakConfig(num_nodes=4, create_rate=20, duration_seconds=4,
+                         scrape_period=1, batch_size=16,
+                         scenario="leader_kill", kill_at_fraction=0.3,
+                         rejoin_after=0.5)
+        report = run_soak(cfg)
+        fo = report.get("failover")
+        assert report.get("wedged") is False, (report.get("error"), fo)
+        assert fo, "leader_kill report must carry its failover block"
+        assert fo["chaos_fired"] is True
+        assert fo["lost_bindings"] == 0
+        assert fo["leader_transitions"] >= 1
+        assert fo["failover_seconds"] is not None
+        assert fo["acked_binds_tracked"] > 0
+        assert fo["members_converged"] is True
+        assert report.get("flight_recorder_bundle")
+
+
+class TestLeaseRelease:
+    def test_graceful_stop_hands_over_immediately(self, tmp_path):
+        """The release-on-stop satellite: a cleanly-stopped leader zeroes
+        the lease and the successor acquires in ~retry_period, not
+        lease_duration."""
+        server = APIServer(Registry(MemStore())).start()
+        mk = lambda name: RESTClient.for_server(  # noqa: E731
+            server, qps=1000, burst=1000, user_agent=name)
+        cfg = dict(lock_namespace="default", lock_name="ha-lock",
+                   lease_duration=30.0,  # a crash handover would take 30s
+                   renew_deadline=5.0, retry_period=0.1)
+        flags = {"a": threading.Event(), "b": threading.Event()}
+        a = LeaderElector(mk("a"), LeaderElectionConfig(identity="a", **cfg),
+                          on_started_leading=flags["a"].set)
+        b = LeaderElector(mk("b"), LeaderElectionConfig(identity="b", **cfg),
+                          on_started_leading=flags["b"].set)
+        try:
+            a.run()
+            assert flags["a"].wait(10)
+            b.run()
+            time.sleep(0.3)  # b is now in its acquire loop, blocked on a
+            assert not b.is_leader
+            t0 = time.monotonic()
+            a.stop()  # graceful: releases the lease record
+            assert flags["b"].wait(10), "successor never acquired"
+            handover = time.monotonic() - t0
+            # far faster than the 30s lease a crash would cost; generous
+            # bound for slow CI
+            assert handover < 10.0
+        finally:
+            a.stop()
+            b.stop()
+            server.stop()

@@ -235,6 +235,12 @@ class TestHorizontalController:
 
             _wait(lambda: client.get("replicasets", "web", "default")
                   .spec.replicas >= 4, timeout=40)
+            # the controller scales the target first and writes HPA status
+            # after — wait for the status write, don't race it
+            _wait(lambda: (client.get("horizontalpodautoscalers", "web-hpa",
+                                      "default").status or
+                           autoscaling.HorizontalPodAutoscalerStatus())
+                  .desired_replicas >= 4, timeout=20)
             hpa = client.get("horizontalpodautoscalers", "web-hpa", "default")
             assert hpa.status.desired_replicas >= 4
         finally:

@@ -107,6 +107,75 @@ class TestLockHeldAcrossIO:
         assert not findings_of(src, "lock-held-across-io")
 
 
+# --- replication-lock-io ------------------------------------------------------
+
+class TestReplicationLockIO:
+    def test_transport_send_under_lock_caught(self):
+        src = """
+        class Group:
+            def commit(self, entry):
+                with self._lock:
+                    ok = self.transport.call(m, "append_entries", entry)
+                return ok
+        """
+        hits = findings_of(src, "replication-lock-io")
+        assert len(hits) == 1
+        assert "transport" in hits[0].message
+
+    def test_replication_rpc_under_lock_caught(self):
+        src = """
+        class Member:
+            def ship(self, peer, entries):
+                with self._lock:
+                    peer.append_entries(self.term, entries)
+        """
+        hits = findings_of(src, "replication-lock-io")
+        assert len(hits) == 1
+        assert "append_entries" in hits[0].message
+
+    def test_fsync_under_lock_caught(self):
+        src = """
+        import os
+        class Member:
+            def append(self, line):
+                with self._lock:
+                    self._wal.write(line)
+                    os.fsync(self._wal.fileno())
+        """
+        hits = findings_of(src, "replication-lock-io")
+        assert len(hits) == 1
+        assert "fsync" in hits[0].message
+
+    def test_structural_split_passes(self):
+        # the shipped shape: stage under the lock, ship + sync outside it,
+        # apply under the lock — and the writer batons (commit/ship gates)
+        # MAY span the round-trip, that is their job
+        src = """
+        import os
+        class Facade:
+            def create(self, key, obj):
+                with self._commit_gate:
+                    with self._lock:
+                        entry = self._stage(key, obj)
+                    self.group.commit(entry)
+                    os.fsync(self._dirfd)
+                    with self._lock:
+                        self._apply(entry)
+        """
+        assert not findings_of(src, "replication-lock-io")
+
+    def test_nested_def_under_lock_not_flagged(self):
+        src = """
+        class Group:
+            def plan(self):
+                with self._lock:
+                    def later():
+                        self.transport.call(m, "append_entries")
+                    return later
+        """
+        assert not findings_of(src, "replication-lock-io")
+
+
 # --- informer-cache-mutation --------------------------------------------------
 
 class TestCacheMutation:

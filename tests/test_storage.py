@@ -1,18 +1,50 @@
-"""MemStore: versioning, CAS, watch window semantics (reference
-pkg/storage interfaces + watch cache behavior)."""
+"""Store contracts: versioning, CAS, watch window semantics (reference
+pkg/storage interfaces + watch cache behavior).
+
+Parameterized over every L0 the registry can mount — MemStore,
+DurableStore, and the quorum ReplicatedStore — because the contract IS
+the acceptance bar for the replication layer: one monotonic
+resourceVersion, CAS update/guaranteed_update, bounded watch window with
+410. A store that needs its own copy of these tests has already diverged.
+"""
 
 import threading
 
 import pytest
 
 from kubernetes_tpu.storage import (
-    ADDED, DELETED, MODIFIED, Conflict, KeyExists, KeyNotFound, MemStore,
-    TooOldResourceVersion,
+    ADDED, DELETED, MODIFIED, Conflict, DurableStore, KeyExists,
+    KeyNotFound, MemStore, ReplicatedStore, TooOldResourceVersion,
 )
 
 
-def test_create_get_versions():
-    s = MemStore()
+@pytest.fixture(params=["mem", "durable", "replicated"])
+def make_store(request, tmp_path):
+    """Factory fixture: make_store(window=..., watcher_queue=...) builds a
+    fresh store of the parameterized kind; teardown closes them all."""
+    created = []
+    seq = [0]
+
+    def factory(**kw):
+        seq[0] += 1
+        if request.param == "mem":
+            s = MemStore(**kw)
+        elif request.param == "durable":
+            s = DurableStore(str(tmp_path / f"d{seq[0]}"), **kw)
+        else:
+            s = ReplicatedStore.local(str(tmp_path / f"r{seq[0]}"), **kw)
+        created.append(s)
+        return s
+
+    yield factory
+    for s in created:
+        close = getattr(s, "close", None)
+        if close is not None:
+            close()
+
+
+def test_create_get_versions(make_store):
+    s = make_store()
     rv1 = s.create("/pods/default/a", {"x": 1})
     rv2 = s.create("/pods/default/b", {"x": 2})
     assert rv2 > rv1
@@ -24,16 +56,16 @@ def test_create_get_versions():
         s.get("/missing")
 
 
-def test_returned_objects_are_copies():
-    s = MemStore()
+def test_returned_objects_are_copies(make_store):
+    s = make_store()
     s.create("/k", {"nested": {"a": 1}})
     obj, _ = s.get("/k")
     obj["nested"]["a"] = 99
     assert s.get("/k")[0]["nested"]["a"] == 1
 
 
-def test_list_prefix_and_snapshot_rv():
-    s = MemStore()
+def test_list_prefix_and_snapshot_rv(make_store):
+    s = make_store()
     s.create("/pods/ns1/a", {"n": "a"})
     s.create("/pods/ns2/b", {"n": "b"})
     s.create("/nodes/n1", {"n": "n1"})
@@ -44,10 +76,10 @@ def test_list_prefix_and_snapshot_rv():
     assert len(items) == 1
 
 
-def test_cas_update():
-    s = MemStore()
+def test_cas_update(make_store):
+    s = make_store()
     rv = s.create("/k", {"v": 0})
-    rv2 = s.update("/k", {"v": 1}, expect_rv=rv)
+    s.update("/k", {"v": 1}, expect_rv=rv)
     with pytest.raises(Conflict):
         s.update("/k", {"v": 2}, expect_rv=rv)  # stale
     assert s.get("/k")[0] == {"v": 1}
@@ -55,8 +87,8 @@ def test_cas_update():
     assert s.get("/k")[0] == {"v": 3}
 
 
-def test_guaranteed_update():
-    s = MemStore()
+def test_guaranteed_update(make_store):
+    s = make_store()
     s.create("/k", {"v": 0})
     obj, rv = s.guaranteed_update("/k", lambda o, _rv: {**o, "v": o["v"] + 1})
     assert obj["v"] == 1
@@ -65,10 +97,10 @@ def test_guaranteed_update():
     assert obj2["v"] == 1 and rv2 == rv
 
 
-def test_guaranteed_update_concurrent():
-    s = MemStore()
+def test_guaranteed_update_concurrent(make_store):
+    s = make_store()
     s.create("/counter", {"v": 0})
-    n_threads, n_incr = 8, 50
+    n_threads, n_incr = 8, 25
 
     def work():
         for _ in range(n_incr):
@@ -80,8 +112,8 @@ def test_guaranteed_update_concurrent():
     assert s.get("/counter")[0]["v"] == n_threads * n_incr
 
 
-def test_delete_and_event():
-    s = MemStore()
+def test_delete_and_event(make_store):
+    s = make_store()
     s.create("/k", {"v": 1})
     w = s.watch("/", since_rv=0)
     obj, rv = s.delete("/k")
@@ -93,8 +125,8 @@ def test_delete_and_event():
 
 
 class TestWatch:
-    def test_live_stream(self):
-        s = MemStore()
+    def test_live_stream(self, make_store):
+        s = make_store()
         w = s.watch("/pods/")
         s.create("/pods/ns/a", {"n": "a"})
         s.update("/pods/ns/a", {"n": "a2"})
@@ -105,8 +137,8 @@ class TestWatch:
         assert w.next(timeout=0.05) is None
         w.stop()
 
-    def test_replay_from_rv(self):
-        s = MemStore()
+    def test_replay_from_rv(self, make_store):
+        s = make_store()
         rv1 = s.create("/pods/ns/a", {"n": "a"})
         s.create("/pods/ns/b", {"n": "b"})
         w = s.watch("/pods/", since_rv=rv1)
@@ -114,15 +146,15 @@ class TestWatch:
         assert ev.obj["n"] == "b" and ev.rv > rv1
         w.stop()
 
-    def test_watch_from_current_rv_sees_nothing_old(self):
-        s = MemStore()
+    def test_watch_from_current_rv_sees_nothing_old(self, make_store):
+        s = make_store()
         s.create("/pods/ns/a", {})
         w = s.watch("/pods/", since_rv=s.current_rv)
         assert w.next(timeout=0.05) is None
         w.stop()
 
-    def test_too_old_resource_version(self):
-        s = MemStore(window=4)
+    def test_too_old_resource_version(self, make_store):
+        s = make_store(window=4)
         for i in range(10):
             s.create(f"/pods/ns/p{i}", {"i": i})
         with pytest.raises(TooOldResourceVersion):
@@ -132,16 +164,16 @@ class TestWatch:
         assert w.next(timeout=1) is not None
         w.stop()
 
-    def test_compaction_forces_relist(self):
-        s = MemStore()
+    def test_compaction_forces_relist(self, make_store):
+        s = make_store()
         rv = s.create("/pods/ns/a", {})
         s.create("/pods/ns/b", {})
         s.compact()
         with pytest.raises(TooOldResourceVersion):
             s.watch("/pods/", since_rv=rv)
 
-    def test_stop_unblocks_iteration(self):
-        s = MemStore()
+    def test_stop_unblocks_iteration(self, make_store):
+        s = make_store()
         w = s.watch("/")
         got = []
 

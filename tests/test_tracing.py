@@ -243,12 +243,15 @@ class TestRetryChainPropagation:
             inf.run()
             assert inf.wait_for_sync(20), "informer never synced"
             assert ctl.count("HTTPError") == 1, "chaos 500 was not injected"
-            wait_for(lambda: audit_tail(verb="GET", path_contains="/pods"),
-                     msg="audited LIST")
-            # the successful LIST records the retry ordinal from the chain
-            lists = [r for r in audit_tail(verb="GET")
-                     if r.path == "/api/v1/pods" and r.status == 200]
-            assert lists, "no successful audited LIST"
+            # the successful LIST records the retry ordinal from the chain.
+            # Wait for the 200-status record SPECIFICALLY: the client's
+            # sync completes when it reads the response, but the server
+            # writes the audit record after sending it — with TCP_NODELAY
+            # those two races are actually visible
+            lists = wait_for(
+                lambda: [r for r in audit_tail(verb="GET")
+                         if r.path == "/api/v1/pods" and r.status == 200],
+                msg="successful audited LIST")
             assert lists[0].retries == 1, lists[0]
             chain_trace = lists[0].trace_id
             # ... and the watch opened after the retry stays ON that trace

@@ -73,6 +73,43 @@ def check(doc: dict, expect_wedged: bool) -> list:
         need(slo, "windows", lambda v: isinstance(v, list) and v, where,
              "non-empty list")
 
+    if (detail.get("config") or {}).get("scenario") == "leader_kill" \
+            and not expect_wedged:
+        # the chaos scenario's verdict blocks: the kill must actually have
+        # fired, the storage leader must have failed over, every acked bind
+        # must survive, and the failover window's black box must exist
+        fo = detail.get("failover")
+        if not isinstance(fo, dict):
+            errs.append("detail.failover: missing (leader_kill must report "
+                        "its chaos verdict)")
+        else:
+            where = "detail.failover"
+            need(fo, "chaos_fired", lambda v: v is True, where,
+                 "true (a leader_kill soak that never killed proved "
+                 "nothing)")
+            need(fo, "failover_seconds", _is_num, where,
+                 "number (the leader must actually have failed over)")
+            need(fo, "leader_transitions",
+                 lambda v: _is_num(v) and v >= 1, where, ">= 1")
+            need(fo, "lost_bindings", lambda v: v == 0, where,
+                 "0 (an acked bind that vanished is the loss this "
+                 "scenario exists to catch)")
+            need(fo, "acked_binds_tracked",
+                 lambda v: _is_num(v) and v > 0, where,
+                 "positive (no tracked binds = the ledger never saw the "
+                 "churn)")
+            need(fo, "members_converged", lambda v: v is True, where,
+                 "true (replicas must agree after rejoin)")
+        bundle = (doc.get("flight_recorder_bundle")
+                  or detail.get("flight_recorder_bundle"))
+        if not bundle:
+            errs.append("$.flight_recorder_bundle: missing (the failover "
+                        "window must ship its black box)")
+        elif not os.path.exists(bundle):
+            errs.append(f"$.flight_recorder_bundle: {bundle} does not exist")
+        else:
+            errs.extend(check_bundle(bundle))
+
     if expect_wedged:
         if not doc.get("wedged"):
             errs.append("$.wedged: expected true (seeded hang must be "

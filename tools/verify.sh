@@ -23,6 +23,15 @@
 #                         flight-recorder bundle; check_soak.py
 #                         --expect-wedged schema-checks both
 #
+#   5b. leader-kill smoke — a ~15 s chaos soak against the replicated
+#                         control plane (3-store quorum, 2 apiservers
+#                         behind the discovery proxy): the storage leader
+#                         and the primary apiserver are killed mid-churn;
+#                         the run must finish with zero lost acked
+#                         bindings, a recorded failover, member
+#                         convergence, and a flight-recorder bundle —
+#                         schema-checked by check_soak.py
+#
 #   6. explain smoke    — tools/explain_smoke.py schedules a mixed
 #                         feasible/infeasible batch through the live kernel
 #                         scheduler and asserts the per-predicate breakdown
@@ -89,6 +98,15 @@ if [ "$run_soak" = 1 ]; then
   fi
   python tools/check_soak.py --expect-wedged "$wedge_out"
   rm -f "$wedge_out"
+
+  echo "== leader-kill smoke (3-store quorum + apiserver failover, zero lost binds) =="
+  lk_out="$(mktemp /tmp/soak-leaderkill.XXXXXX.json)"
+  JAX_PLATFORMS=cpu SOAK_NODES=8 SOAK_RATE=40 SOAK_DURATION=6 \
+    SOAK_SCRAPE_PERIOD=1 SOAK_BATCH=32 \
+    timeout -k 10 300 python bench.py --mode soak --scenario leader_kill \
+    > "$lk_out"
+  python tools/check_soak.py "$lk_out"
+  rm -f "$lk_out"
 fi
 
 if [ "$run_trace" = 1 ]; then
