@@ -741,6 +741,75 @@ def measure_objective_overhead(jax_mod, objective_name: str) -> dict:
     }
 
 
+def solve_and_count(arrays, ct, weights, feats, wave: int):
+    """One dispatch, host-materialized (the sync barrier); returns
+    (assignments, wave_count) — wave_count 0 on the serial path. The ONE
+    place that unpacks the wave return shape for the bench."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.kernel import _schedule_jit
+    out = _schedule_jit(arrays, ct.n_zones, weights, feats, False, None,
+                        wave)
+    if wave:
+        ret, waves = out
+        return np.asarray(ret), int(waves)
+    return np.asarray(out), 0
+
+
+def measure_sharded(jax_mod, ct, weights, feats, wave: int,
+                    res_unsharded, n_runs: int) -> dict:
+    """The 8x the ROADMAP says is being left on the table: run the SAME
+    solve program with inputs laid out over the full ("pods", "nodes")
+    device mesh, assert the sharded assignments equal the unsharded ones
+    bit-for-bit, and report the sharded steady-state next to the
+    single-chip number. Raises on inequality — a sharded solve that
+    disagrees is not a speedup, it is a wrong answer."""
+    import statistics
+
+    import numpy as np
+
+    from kubernetes_tpu.ops.sharding import make_mesh, shard_arrays
+
+    ndev = len(jax_mod.devices())
+    mesh = make_mesh(ndev)
+
+    def solve_np(a):
+        return solve_and_count(a, ct, weights, feats, wave)
+
+    with mesh:
+        arrays = shard_arrays(mesh, ct.arrays())
+        jax_mod.block_until_ready(arrays)
+        t0 = time.perf_counter()
+        sres, swaves = solve_np(arrays)
+        compile_seconds = time.perf_counter() - t0
+        if not np.array_equal(sres, res_unsharded):
+            diff = int((sres != res_unsharded).sum())
+            raise AssertionError(
+                f"sharded != unsharded assignments ({diff} rows differ)")
+        runs = []
+        for k in range(1, n_runs + 1):
+            a = dict(arrays)
+            a["used0"] = arrays["used0"].at[0, 0].add(np.float32(k) * 1e-3)
+            jax_mod.block_until_ready(a["used0"])
+            t0 = time.perf_counter()
+            solve_np(a)
+            runs.append(time.perf_counter() - t0)
+    med = statistics.median(runs)
+    scheduled = int((res_unsharded[: ct.n_real_pods] >= 0).sum())
+    out = {
+        "devices": ndev,
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "equal": True,
+        "kernel_seconds": round(med, 4),
+        "pods_per_sec": round(scheduled / med, 1) if med > 0 else 0.0,
+        "compile_seconds": round(compile_seconds, 1),
+        "runs": [round(r, 4) for r in runs],
+    }
+    if wave:
+        out["wave_count"] = swaves
+    return out
+
+
 def restart_probe() -> None:
     """Fresh-process cold start against the persistent compilation cache:
     module load -> backend -> tensorize -> upload -> (cached) compile ->
@@ -749,7 +818,7 @@ def restart_probe() -> None:
     try:
         jax, devs, backend_err = init_backend()
         from kubernetes_tpu.ops.kernel import (
-            Weights, _schedule_jit, features_of,
+            Weights, _schedule_jit, features_of, resolve_wave,
         )
         from kubernetes_tpu.ops.tensorize import Tensorizer
         from kubernetes_tpu.scheduler.batch import (
@@ -766,8 +835,15 @@ def restart_probe() -> None:
         arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
         t_pre = time.perf_counter()
         cc_before = plat.compile_cache_snapshot()
-        out = np.asarray(_schedule_jit(arrays, ct.n_zones, Weights(),
-                                       features_of(ct)))
+        # the SAME program the flagship solve compiled (wave by default):
+        # the probe proves the persistent cache serves the program the
+        # restarted scheduler will actually run
+        wv = resolve_wave(None)
+        out = _schedule_jit(arrays, ct.n_zones, Weights(),
+                            features_of(ct), False, None, wv)
+        if wv:
+            out = out[0]
+        out = np.asarray(out)
         t_done = time.perf_counter()
         cc_event = plat.record_compile_cache_event(cc_before)
         print(json.dumps({
@@ -808,7 +884,9 @@ def main() -> int:
         fail_json("backend_init", e)
         return 1
 
-    from kubernetes_tpu.ops.kernel import Weights, _schedule_jit, features_of
+    from kubernetes_tpu.ops.kernel import (
+        Weights, _schedule_jit, features_of, resolve_wave,
+    )
     from kubernetes_tpu.ops.tensorize import Tensorizer
     from kubernetes_tpu.scheduler.batch import ListServiceLister, make_plugin_args
 
@@ -844,6 +922,12 @@ def main() -> int:
     feats = features_of(ct)
     import numpy as np
     n_runs = max(1, int(os.environ.get("BENCH_RUNS", 10)))
+    # the flagship solve is the wave-commit program (KTPU_WAVE=0 reverts
+    # to the serial per-pod scan); wave_count is the new serial dimension
+    wv = resolve_wave(None)
+
+    def solve_np(a):
+        return solve_and_count(a, ct, weights, feats, wv)
 
     def perturb(k):
         """Fresh input dict differing in one element — every dispatch is
@@ -858,11 +942,11 @@ def main() -> int:
         from kubernetes_tpu.utils import platform as plat
 
         def compile_and_run():
-            out = _schedule_jit(arrays, ct.n_zones, weights, feats)
             # host materialization is the sync barrier (see module docstring)
-            return np.asarray(out)
+            return solve_np(arrays)
         cc_before = plat.compile_cache_snapshot()
-        res_full = run_with_timeout(compile_and_run, 900, "kernel compile")
+        res_full, wave_count = run_with_timeout(
+            compile_and_run, 900, "kernel compile")
         t_compiled = time.perf_counter()
         plat.record_compile_cache_event(cc_before)
         METRICS.observe("scheduler_stage_seconds", t_compiled - t_upload,
@@ -875,7 +959,7 @@ def main() -> int:
                 a = perturb(k)
                 jax.block_until_ready(a["used0"])  # perturbation off the clock
                 t0 = time.perf_counter()
-                np.asarray(_schedule_jit(a, ct.n_zones, weights, feats))
+                solve_np(a)
                 dt = time.perf_counter() - t0
                 METRICS.observe("scheduler_stage_seconds", dt, stage="solve")
                 runs.append(dt)
@@ -885,9 +969,10 @@ def main() -> int:
             ins = [perturb(k) for k in ks]
             jax.block_until_ready([a["used0"] for a in ins])
             t0 = time.perf_counter()
-            outs = [_schedule_jit(a, ct.n_zones, weights, feats) for a in ins]
+            outs = [_schedule_jit(a, ct.n_zones, weights, feats,
+                                  False, None, wv) for a in ins]
             for o in outs:
-                np.asarray(o)
+                jax.tree_util.tree_map(np.asarray, o)
             b2b = (time.perf_counter() - t0) / len(ks)
             return runs, b2b
         runs, b2b = run_with_timeout(steady_state, 600, "steady state")
@@ -913,6 +998,19 @@ def main() -> int:
 
     res = res_full[: ct.n_real_pods]
     scheduled = int((res >= 0).sum())
+
+    # sharded side-by-side (ROADMAP item 1's 8x): same program over the
+    # full device mesh, bit-equality asserted against the unsharded result
+    sharded = None
+    if os.environ.get("BENCH_SHARDED", "1") != "0" \
+            and len(jax.devices()) > 1:
+        try:
+            sharded = run_with_timeout(
+                lambda: measure_sharded(jax, ct, weights, feats, wv,
+                                        res_full, n_runs),
+                900, "sharded solve")
+        except Exception as e:
+            sharded = {"error": repr(e), "equal": False}
 
     # the live end-to-end path (round-3 verdict #1b): full scale on the
     # device; reduced scale on the CPU fallback so an honest number still
@@ -991,6 +1089,17 @@ def main() -> int:
                          for k, v in feats._asdict().items()},
         },
     }
+    if wv:
+        # the wave-commit telemetry: wave_count IS the kernel's serial
+        # dimension now (vs the per-pod scan's P steps)
+        result["detail"]["wave_chunk"] = wv
+        result["detail"]["wave_count"] = wave_count
+        result["detail"]["waves_per_second"] = round(
+            wave_count / kernel_seconds, 1) if kernel_seconds > 0 else 0.0
+        result["detail"]["scan_step_reduction"] = round(
+            ct.n_real_pods / max(wave_count, 1), 1)
+    if sharded is not None:
+        result["detail"]["sharded"] = sharded
     # per-stage pipeline breakdown + compile-cache ledger, straight from the
     # metrics registry (includes the e2e run's scheduler-recorded stages)
     result["detail"]["pipeline"] = pipeline_breakdown()
@@ -1015,24 +1124,37 @@ def main() -> int:
     result["wedged"] = bool(timeouts)
     if timeouts:
         result["detail"]["stage_timeouts"] = timeouts
-        # a wedged round ships its black box: spans (incl. the timed-out
-        # stage), audit tail, events, metric deltas — the next BENCH attempt
-        # is diagnosable from artifacts alone
-        bundle = flight_dump("bench-wedged",
-                             trigger={"stage_timeouts": timeouts})
-        if bundle:
-            result["flight_recorder_bundle"] = bundle
-    print(json.dumps(result))
+    # collect every nonzero-exit cause BEFORE printing, so the forensic
+    # bundle below can ride the report for ALL of them — a wave-parity or
+    # sharding-equality failure on TPU must be diagnosable from artifacts
+    # alone, exactly like a wedge
+    fail_reasons = {}
+    if timeouts:
+        fail_reasons["stage_timeouts"] = timeouts
     if restart is not None and restart.get("error"):
-        return 1  # a failed restart probe is not a clean measurement
+        # a failed restart probe is not a clean measurement
+        fail_reasons["restart"] = restart["error"]
     if explain_overhead is not None and (explain_overhead.get("exceeded")
                                          or explain_overhead.get("error")):
-        return 1  # explain must stay within 2% — and must be measurable
+        # explain must stay within 2% — and must be measurable
+        fail_reasons["explain_overhead"] = explain_overhead
     if objective_overhead is not None and (
             objective_overhead.get("exceeded")
             or objective_overhead.get("error")):
-        return 1  # objective modes: bounded overhead + exact off-identity
-    return 1 if timeouts else 0
+        # objective modes: bounded overhead + exact off-identity
+        fail_reasons["objective_overhead"] = objective_overhead
+    if sharded is not None and not sharded.get("equal"):
+        # a sharded solve that disagrees (or couldn't run) is not a number
+        fail_reasons["sharded"] = sharded.get("error", "not equal")
+    if fail_reasons:
+        bundle = flight_dump(
+            "bench-wedged" if timeouts else "bench-nonzero-exit",
+            trigger={"reasons": {k: repr(v)[:500]
+                                 for k, v in fail_reasons.items()}})
+        if bundle:
+            result["flight_recorder_bundle"] = bundle
+    print(json.dumps(result))
+    return 1 if fail_reasons else 0
 
 
 def main_soak() -> int:
@@ -1051,6 +1173,7 @@ def main_soak() -> int:
         duration_seconds=float(os.environ.get("SOAK_DURATION", 60)),
         scrape_period=float(os.environ.get("SOAK_SCRAPE_PERIOD", 2)),
         batch_size=int(os.environ.get("SOAK_BATCH", 256)),
+        microbatch_ms=float(os.environ.get("SOAK_MICROBATCH_MS", 0)),
         hang_stage=os.environ.get("BENCH_SOAK_HANG_STAGE", ""),
         scenario=os.environ.get("SOAK_SCENARIO", "churn"),
         gang_size=int(os.environ.get("SOAK_GANG_SIZE", 3)),
@@ -1122,4 +1245,11 @@ if __name__ == "__main__":
         restart_probe()
         sys.exit(0)
     mode = parse_mode(sys.argv[1:])
-    sys.exit(main_soak() if mode == "soak" else main())
+    try:
+        rc = main_soak() if mode == "soak" else main()
+    except Exception as e:  # incl. assertion failures in the guards
+        # ANY nonzero exit ships its black box: fail_json dumps a
+        # flight-recorder bundle and prints the error-shaped report
+        fail_json("unhandled", e)
+        rc = 1
+    sys.exit(rc)

@@ -54,6 +54,10 @@ class SoakConfig:
     warmup_rounds: int = 1            # rounds excluded from steady state
     max_in_flight: int = 0            # live pod cap; 0 = 2s worth of rate
     batch_size: int = 256
+    # micro-batch window: the scheduler solves every `microbatch_ms` (or a
+    # full batch, whichever first) instead of per-burst — the report's
+    # `microbatch` block carries rounds-per-second next to pods/s
+    microbatch_ms: float = 0.0
     heartbeat_period: float = 10.0
     drain_timeout: float = 30.0       # wait for stragglers after churn
     # scenario: "churn" (singleton pods), "gang_churn" — gangs of
@@ -414,7 +418,8 @@ def _boot(cfg: SoakConfig, state: dict, scraper: Optional[Scraper]) -> None:
     factory.run(timeout=60)
     sched = state["sched"] = factory.create_batch_from_provider(
         batch_size=cfg.batch_size, stage_deadlines=cfg.stage_deadlines,
-        objective=cfg.effective_objective() or None)
+        objective=cfg.effective_objective() or None,
+        microbatch_ms=cfg.microbatch_ms)
     if cfg.hang_stage:
         _seed_hang(sched, cfg.hang_stage)
     # the debug mux every component serves; the scraper reads THIS, not the
@@ -433,6 +438,11 @@ def _boot(cfg: SoakConfig, state: dict, scraper: Optional[Scraper]) -> None:
         # as this soak's (including phantom wedge verdicts). Fatal.
         raise RuntimeError("baseline scrape of the scheduler target failed")
     state["steady_from_ts"] = base.ts
+    # kernel-round baselines for the microbatch block (rebased again at
+    # warmup end, like the e2e count): boot/warmup rounds are not
+    # steady-state cadence
+    state["rounds_base"] = sched.kernel_batches
+    state["kpods_base"] = sched.kernel_pods
     # absolute baselines (counter values, not rounds): totals stay correct
     # even when a long soak outgrows the scraper's bounded round history
     fam = base.families.get(TIMEOUT_COUNTER)
@@ -684,6 +694,10 @@ def _record_round(cfg: SoakConfig, state: dict, report: dict,
         if last is not None:
             state["steady_from_ts"] = last.ts
             state["steady_base_count"] = _e2e_count(last)
+        sched = state.get("sched")
+        if sched is not None:
+            state["rounds_base"] = sched.kernel_batches
+            state["kpods_base"] = sched.kernel_pods
 
 
 def _drain(cfg: SoakConfig, state: dict, report: dict) -> None:
@@ -763,6 +777,28 @@ def _finalize(cfg: SoakConfig, state: dict, report: dict) -> None:
     out["kernel"] = {
         "batches": sched.kernel_batches, "pods": sched.kernel_pods,
         "failures": sched.kernel_failures, "health": sched.health,
+    }
+    # the micro-batch verdict: solve cadence next to throughput, plus the
+    # device-residency proof (the incremental mirror's node-side arrays and
+    # victim tables re-upload only on change — last_upload_bytes is the
+    # per-round H2D bill, not a full re-tensorize)
+    inc = getattr(sched, "_inc", None)
+    # steady-window cadence: rounds/pods rebased against the warmup-end
+    # snapshot, exactly like the steady_state e2e count above
+    steady_rounds = sched.kernel_batches - state.get("rounds_base", 0)
+    steady_kpods = sched.kernel_pods - state.get("kpods_base", 0)
+    out["microbatch"] = {
+        "window_ms": cfg.microbatch_ms,
+        "rounds": steady_rounds,
+        "rounds_per_second": num(steady_rounds / steady_window)
+        if steady_window > 0 else None,
+        "avg_pods_per_round": num(steady_kpods / max(steady_rounds, 1)),
+        "device_resident": inc is not None,
+        "incremental_builds": inc.builds if inc is not None else 0,
+        "last_upload_bytes": inc.last_upload_bytes
+        if inc is not None else None,
+        "last_build_seconds": num(inc.last_build_seconds, 4)
+        if inc is not None else None,
     }
     rounds = list(scr._rounds.get("scheduler", ()))
     out["scrape"] = {

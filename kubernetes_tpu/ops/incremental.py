@@ -203,6 +203,25 @@ class IncrementalTensorizer:
         self.sym_t = _TermTable(N)
         self.te_t = _TermTable(N, weighted=True)
 
+        # preempt mode: per-slot victim candidate lists kept SORTED by
+        # (priority, pod key) and mirrored into the vict_prio/vict_cum
+        # prefix tables in O(pods-on-node) per pod event — the delta-path
+        # replacement for the per-batch host-side O(placed·log) rebuild
+        # (ROADMAP 3b). KV is grow-only, so the kernel's jit key no longer
+        # churns with the per-batch victim maximum.
+        self._preempt = (self.objective is not None
+                         and self.objective.preempt)
+        if self._preempt:
+            from kubernetes_tpu.scheduler.objectives.config import (
+                INF_PRIORITY,
+            )
+            self._vict_kv = 8
+            self._vict_lists: Dict[int, list] = {}  # slot -> [(prio, key, vec6)]
+            self._vict_entry: Dict[str, tuple] = {}  # key -> (slot, prio, vec6)
+            self.vict_prio = np.full((self._vict_kv, N), INF_PRIORITY,
+                                     np.float32)
+            self.vict_cum = np.zeros((6, self._vict_kv + 1, N), np.float32)
+
         # placed-pod registry, grouped by (ns, labels signature) for fast
         # new-term/new-group initialization scans
         self._placed: Dict[str, Tuple[api.Pod, int]] = {}
@@ -275,6 +294,16 @@ class IncrementalTensorizer:
         self.node_dom = nd
         for t in (self.req_t, self.anti_t, self.pref_t, self.sym_t, self.te_t):
             t.grow_nodes(N)
+        if self._preempt:
+            from kubernetes_tpu.scheduler.objectives.config import (
+                INF_PRIORITY,
+            )
+            vp = np.full((self._vict_kv, N), INF_PRIORITY, np.float32)
+            vp[:, : self.vict_prio.shape[1]] = self.vict_prio
+            vc = np.zeros((6, self._vict_kv + 1, N), np.float32)
+            vc[:, :, : self.vict_cum.shape[2]] = self.vict_cum
+            self.vict_prio, self.vict_cum = vp, vc
+            self._touch("vict_prio", "vict_cum")
         self._node_names.extend([""] * (N - len(self._node_names)))
         self._touch("alloc", "node_labels", "taints_nosched", "taints_prefer",
                     "mem_pressure", "node_valid", "zone_id", "image_node_sizes",
@@ -568,6 +597,8 @@ class IncrementalTensorizer:
                 self.node_ports0[slot, c] = 1 if self._ports_cnt[slot, c] > 0 else 0
             self._touch("node_ports0")
 
+        if self._preempt:
+            self._apply_victim(pod, slot, sign, shape, key)
         self._apply_volumes(pod, slot, sign, shape, key)
         self._apply_groups(pod, slot, sign)
         self._apply_interpod(pod, slot, sign)
@@ -654,6 +685,70 @@ class IncrementalTensorizer:
             self._gce_cnt[slot, c] += sign
             self.node_gce0[slot, c] = 1 if self._gce_cnt[slot, c] > 0 else 0
         self._touch("node_disk_any0", "node_disk_rw0", "node_ebs0", "node_gce0")
+
+    # --- preempt victim prefix tables (delta path) ----------------------------
+
+    def _apply_victim(self, pod: api.Pod, slot: int, sign: int, shape: dict,
+                      key: str):
+        """Keep vict_prio/vict_cum exact under pod add/remove: a sorted
+        per-slot candidate list plus an O(pods-on-node) column rewrite —
+        never a full re-sort of the placed set."""
+        import bisect
+
+        from kubernetes_tpu.scheduler.objectives.config import pod_priority
+        if sign > 0:
+            if pod.metadata and pod.metadata.deletion_timestamp:
+                return  # a pod on its way out is not a victim candidate
+            pr = pod_priority(pod)
+            vec = np.concatenate([shape["req4"], shape["nz2"]]).astype(
+                np.float32)
+            lst = self._vict_lists.setdefault(slot, [])
+            # keys are unique per slot, so the (prio, key) prefix always
+            # decides the order before the ndarray is ever compared
+            bisect.insort(lst, (pr, key, vec))
+            self._vict_entry[key] = (slot, pr, vec)
+        else:
+            ent = self._vict_entry.pop(key, None)
+            if ent is None:
+                return  # was terminating at add time: never a candidate
+            slot = ent[0]
+            lst = self._vict_lists.get(slot, [])
+            for j, e in enumerate(lst):
+                if e[1] == key:
+                    del lst[j]
+                    break
+        while len(self._vict_lists.get(slot, ())) > self._vict_kv:
+            self._grow_victims()
+        self._rebuild_vict_col(slot)
+        self._touch("vict_prio", "vict_cum")
+
+    def _grow_victims(self):
+        from kubernetes_tpu.scheduler.objectives.config import INF_PRIORITY
+        kv2 = self._vict_kv * 2
+        vp = np.full((kv2, self.n_cap), INF_PRIORITY, np.float32)
+        vp[: self._vict_kv] = self.vict_prio
+        vc = np.zeros((6, kv2 + 1, self.n_cap), np.float32)
+        vc[:, : self._vict_kv + 1] = self.vict_cum
+        # beyond the last victim the prefix stays flat (clipped gathers
+        # then read "no further relief")
+        vc[:, self._vict_kv + 1:] = self.vict_cum[:, -1:, :]
+        self._vict_kv = kv2
+        self.vict_prio, self.vict_cum = vp, vc
+        self._touch("vict_prio", "vict_cum")
+
+    def _rebuild_vict_col(self, slot: int):
+        from kubernetes_tpu.scheduler.objectives.config import INF_PRIORITY
+        lst = self._vict_lists.get(slot, ())
+        kv = self._vict_kv
+        self.vict_prio[:, slot] = INF_PRIORITY
+        acc = np.zeros(6, np.float32)
+        col = np.zeros((6, kv + 1), np.float32)
+        for j, (pr, _key, vec) in enumerate(lst):
+            self.vict_prio[j, slot] = pr
+            acc = acc + vec
+            col[:, j + 1] = acc
+        col[:, len(lst) + 1:] = acc[:, None]
+        self.vict_cum[:, :, slot] = col
 
     # --- spread groups --------------------------------------------------------
 
@@ -1347,22 +1442,34 @@ class IncrementalTensorizer:
         )
         objective_kw = {}
         if self.objective is not None:
+            import dataclasses
+
+            from kubernetes_tpu.scheduler.objectives.config import (
+                pod_priority,
+            )
             from kubernetes_tpu.scheduler.objectives.tensors import (
                 build_objective_tensors,
             )
-            # victim candidates: the mirror's placed set minus terminating
-            # pods — the same exclusion the full Tensorizer applies.
-            # NOTE: unlike the node tensors the mirror keeps device-
-            # resident, the victim prefix tables are rebuilt host-side per
-            # batch (an O(placed·log) sort + [6, KV+1, N] upload) — at the
-            # 30k-pod target this belongs in the delta path; until then
-            # preempt mode pays it inside tensorize/upload (ROADMAP 3b)
-            placed_live = [(pod, slot) for key, (pod, slot)
-                           in self._placed.items()
-                           if key not in self._terminating]
+            # preempt's victim prefix tables live in the DELTA path
+            # (_apply_victim): maintained per pod event, device-resident
+            # via the node-side cache — build_objective_tensors only runs
+            # for the gang arrays and the per-batch pending priorities
+            obj_for_build = (dataclasses.replace(self.objective,
+                                                 preempt=False)
+                             if self._preempt else self.objective)
             arrays, info = build_objective_tensors(
-                self.objective, pending, Pp, N,
-                lambda slot: self._node_labels_d.get(slot, {}), placed_live)
+                obj_for_build, pending, Pp, N,
+                lambda slot: self._node_labels_d.get(slot, {}), [])
+            if self._preempt:
+                prio = np.zeros(Pp, np.float32)
+                for p, pod in enumerate(pending):
+                    prio[p] = pod_priority(pod)
+                arrays["pod_priority"] = prio
+                arrays["vict_prio"] = self.vict_prio
+                arrays["vict_cum"] = self.vict_cum
+                info.victim_order = [
+                    [key for _pr, key, _v in self._vict_lists.get(s, ())]
+                    for s in range(N)]
             objective_kw = dict(arrays)
             objective_kw["objective_info"] = info
         return ClusterTensors(
@@ -1422,6 +1529,9 @@ class IncrementalTensorizer:
         "expr_node", "pref_term_node", "pref_weight", "req_hit0", "anti_hit0",
         "pref_hit0", "sym_dom0", "te_dom0", "node_disk_any0", "node_disk_rw0",
         "node_ebs0", "node_gce0",
+        # preempt victim prefix tables: delta-maintained, so their device
+        # copies survive across batches exactly like the other node state
+        "vict_prio", "vict_cum",
     ))
 
     def device_sync(self, ct: ClusterTensors, device=None):
@@ -1525,10 +1635,12 @@ class IncrementalTensorizer:
         than the hang being converted."""
         from kubernetes_tpu.ops.kernel import (
             Weights, decode_dispatch, dispatch, features_of,
+            record_wave_count, resolve_wave,
         )
         weights = weights or Weights()
         run = stage or (lambda _n, fn: fn())
         objective = self.objective
+        wave = resolve_wave(None, n_pods=len(pending))
         perm = None
         if objective is not None and objective.gang:
             # gang members must be contiguous in scan order; solve in the
@@ -1550,7 +1662,8 @@ class IncrementalTensorizer:
         arrays = run("upload", lambda: self._upload_staged(plan,
                                                            device=device))
         out = dispatch(arrays, n_zones, weights, feats, stage=stage,
-                       explain=explain, objective=objective)
+                       explain=explain, objective=objective, wave=wave)
+        out = record_wave_count(out, wave)
         ret = decode_dispatch(ct, out, weights, feats, explain, objective)
         if perm is None:
             return ret

@@ -65,6 +65,13 @@ Integer-truncation points match the Go code: calculateScore's
 All shapes are static per batch (padded); the jit cache is keyed by padded
 (P, N, vocab) sizes + Features, so repeated batches of similar shape reuse
 the compile.
+
+The serial scan is no longer the default solve: ops/wave.py restructures
+stage B into WAVE COMMIT — bulk-committing non-interacting FIFO prefixes
+per step, bit-identical to this scan by construction (it runs this module's
+step function for complex pods and proves fixed-point equality for the
+rest) — shrinking the sequential dimension from P pod-steps to the
+measured wave count. KTPU_WAVE=0 selects the serial scan.
 """
 
 from __future__ import annotations
@@ -367,54 +374,15 @@ class _Layout:
         return row[self.spans[name]]
 
 
-def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
+def build_program(t: dict, s: dict, w: Weights, feats: Features,
                   explain: bool = False,
                   obj: Optional[ObjectiveConfig] = None):
-    """lax.scan over pods; returns assignments [P] i32 (-1 = unschedulable).
-
-    Exactly the reference's sequential semantics (scheduler.go:93-155 one
-    pod at a time over generic_scheduler.go:70-133), with the per-step work
-    packed into ~25 fused ops (see module docstring).
-
-    With explain, additionally returns a dict of per-pod provenance emitted
-    straight from the scan — (assignments, extras) instead of assignments:
-
-    - ``surv`` [P, 8]: cumulative surviving-node counts after each dynamic
-      predicate (pod-count, cpu, mem, gpu, ports, disk, volume-caps,
-      inter-pod), continuing the static chain from static_pass — ONE
-      stacked masked reduction over the mask ingredients the step already
-      computed, never a [P, N, K] tensor. Rows for untraced features repeat
-      the previous count (zero eliminations), keeping the axis static.
-    - ``win_comp`` [P, C] / ``win_total`` [P]: the weighted score
-      decomposition at the chosen node (component order:
-      explain_component_names) and its total.
-    - ``run_idx`` / ``run_total`` / ``run_comp``: the runner-up node (max
-      score excluding the winner; NEG total = no second feasible node).
-
-    When explain is off this function traces the exact program it always
-    has — the flag is a static jit key, so `off` is bit-identical to
-    today's assignments, and `on` only ADDS reductions (the mask and score
-    math feeding the argmax is shared, also bit-identical).
-
-    With `obj` (an enabled ObjectiveConfig — also a static jit key, so the
-    default/None path is the untouched pre-objective program), the scan
-    additionally solves the scheduling-objective modes in-step:
-
-    - binpack: a MostRequested fragmentation score component;
-    - preempt: a pod with zero feasible nodes nominates victims as a masked
-      argmin over (victim priority, victim count, node order) against the
-      per-node sorted victim prefix tables (vict_prio/vict_cum), relieves
-      the victims' resource occupancy in-carry, and commits at the
-      nominated node; per-pod victim counts stream out as `pk`;
-    - gang: gang members (contiguous in pod order — objectives.gang_order)
-      are masked to nodes sharing one topology-label domain, commit deltas
-      accumulate in a per-open-gang carry, and a member with zero feasible
-      nodes rolls the whole gang's nstate deltas back inside the scan and
-      marks the gang failed (all-or-nothing — the host decode nullifies the
-      already-emitted member assignments). Port/affinity-hit shadows from
-      rolled-back members deliberately persist until the next batch
-      (conservative; state is rebuilt per batch), and gang members never
-      preempt — both mirrored exactly by the oracle replay."""
+    """Shared solver builder: the packing prologue + the per-pod step
+    function, used by BOTH the serial scan (greedy_commit) and the wave
+    solver (ops/wave.py). The wave path's single-pod commits run this exact
+    step function, and its batched decide reads the same packed operands
+    through `ctx`, so the two solvers cannot drift apart formula-wise.
+    Returns (step, xs, init, ctx)."""
     assert not feats.hw or feats.req, "hw carry requires the req term table"
     obj_on = obj is not None and obj.enabled
     use_gang = obj_on and obj.gang
@@ -1033,6 +1001,84 @@ def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
             return out, (chosen, objy, extras)
         return out, (chosen, objy)
 
+    from types import SimpleNamespace
+    ctx = SimpleNamespace(
+        obj_on=obj_on, use_gang=use_gang, use_preempt=use_preempt,
+        use_binpack=use_binpack, use_ip=use_ip, use_st=use_st,
+        use_vocab=use_vocab, use_image=use_image, explain=explain,
+        feats=feats, obj=obj, wf=wf, lay=lay, N=N, G=G, Z=Z,
+        null_group=null_group, idx_n=idx_n, allocT=allocT,
+        cap_c=cap_c, cap_m=cap_m, zone_onehot_t=zone_onehot_t,
+        zone_id=t["zone_id"],
+        chan_idx=chan_idx if use_vocab else None,
+        SS=SS,
+        max_ebs=t.get("max_ebs"), max_gce=t.get("max_gce"),
+        T=T if use_ip else 0,
+        topo_stack=topo_stack if use_ip else None,
+        hit_is_max=hit_is_max if use_ip else None,
+        node_dom=node_dom if use_ip else None,
+        hard_w=hard_w if use_ip else None,
+        pref_w=pref_w if use_ip else None,
+        static2=static2 if use_st else None,
+        KV=KV if use_preempt else 0,
+        g_null=g_null if use_gang else 0,
+        node_gang_dom=t["node_gang_dom"] if use_gang else None,
+    )
+    return step, xs, init, ctx
+
+
+def greedy_commit(t: dict, s: dict, w: Weights, feats: Features,
+                  explain: bool = False,
+                  obj: Optional[ObjectiveConfig] = None):
+    """lax.scan over pods; returns assignments [P] i32 (-1 = unschedulable).
+
+    Exactly the reference's sequential semantics (scheduler.go:93-155 one
+    pod at a time over generic_scheduler.go:70-133), with the per-step work
+    packed into ~25 fused ops (see module docstring).
+
+    With explain, additionally returns a dict of per-pod provenance emitted
+    straight from the scan — (assignments, extras) instead of assignments:
+
+    - ``surv`` [P, 8]: cumulative surviving-node counts after each dynamic
+      predicate (pod-count, cpu, mem, gpu, ports, disk, volume-caps,
+      inter-pod), continuing the static chain from static_pass — ONE
+      stacked masked reduction over the mask ingredients the step already
+      computed, never a [P, N, K] tensor. Rows for untraced features repeat
+      the previous count (zero eliminations), keeping the axis static.
+    - ``win_comp`` [P, C] / ``win_total`` [P]: the weighted score
+      decomposition at the chosen node (component order:
+      explain_component_names) and its total.
+    - ``run_idx`` / ``run_total`` / ``run_comp``: the runner-up node (max
+      score excluding the winner; NEG total = no second feasible node).
+
+    When explain is off this function traces the exact program it always
+    has — the flag is a static jit key, so `off` is bit-identical to
+    today's assignments, and `on` only ADDS reductions (the mask and score
+    math feeding the argmax is shared, also bit-identical).
+
+    With `obj` (an enabled ObjectiveConfig — also a static jit key, so the
+    default/None path is the untouched pre-objective program), the scan
+    additionally solves the scheduling-objective modes in-step:
+
+    - binpack: a MostRequested fragmentation score component;
+    - preempt: a pod with zero feasible nodes nominates victims as a masked
+      argmin over (victim priority, victim count, node order) against the
+      per-node sorted victim prefix tables (vict_prio/vict_cum), relieves
+      the victims' resource occupancy in-carry, and commits at the
+      nominated node; per-pod victim counts stream out as `pk`;
+    - gang: gang members (contiguous in pod order — objectives.gang_order)
+      are masked to nodes sharing one topology-label domain, commit deltas
+      accumulate in a per-open-gang carry, and a member with zero feasible
+      nodes rolls the whole gang's nstate deltas back inside the scan and
+      marks the gang failed (all-or-nothing — the host decode nullifies the
+      already-emitted member assignments). Port/affinity-hit shadows from
+      rolled-back members deliberately persist until the next batch
+      (conservative; state is rebuilt per batch), and gang members never
+      preempt — both mirrored exactly by the oracle replay."""
+    step, xs, init, _ = build_program(t, s, w, feats, explain, obj)
+    obj_on = obj is not None and obj.enabled
+    use_gang = obj_on and obj.gang
+
     # unroll amortizes per-iteration loop overhead; the body is tiny
     # (elementwise over N + a few [T, N] contractions) so overhead dominates
     if not obj_on:
@@ -1061,12 +1107,43 @@ _INT_FIELDS = frozenset(("zone_id", "host_req", "node_dom", "pod_group",
                          "pod_gang", "node_gang_dom", "gang_dom0"))
 
 
+# wave-commit solve (ops/wave.py): default chunk width and the env seam.
+# KTPU_WAVE=0 forces the serial per-pod scan; KTPU_WAVE_CHUNK tunes the
+# per-wave decide width (the parallel pod-axis slab each wave considers).
+WAVE_CHUNK = 512
+
+
+def resolve_wave(wave=None, n_pods: Optional[int] = None) -> int:
+    """Resolve a wave selector to a static chunk size (0 = serial scan).
+
+    None consults KTPU_WAVE / KTPU_WAVE_CHUNK (wave commit is the default
+    solve path); True selects the default chunk; an int is the chunk.
+
+    In the automatic (None) mode, batches below KTPU_WAVE_MIN pods
+    (default 256) take the serial scan: a handful of scan steps beats the
+    wave program's chunked decide there, and small batches dominate test
+    suites and light traffic — the wave machinery is for the shapes where
+    the serial dimension is the wall. An explicit `wave` always wins."""
+    import os
+    if wave is None:
+        if os.environ.get("KTPU_WAVE", "1") in ("0", "off", "false"):
+            return 0
+        if n_pods is not None and n_pods < int(
+                os.environ.get("KTPU_WAVE_MIN", 256)):
+            return 0
+        return int(os.environ.get("KTPU_WAVE_CHUNK", WAVE_CHUNK))
+    if wave is True:
+        return WAVE_CHUNK
+    return int(wave)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("n_zones", "weights", "feats", "explain",
-                                    "objective"))
+                                    "objective", "wave"))
 def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
                   feats: Features, explain: bool = False,
-                  objective: Optional[ObjectiveConfig] = None):
+                  objective: Optional[ObjectiveConfig] = None,
+                  wave: int = 0):
     # indicator/count matrices may arrive packed (int8/int16/int32 — 4x less
     # upload traffic than f32, ops/incremental.py); widen on-device where
     # the MXU wants floats. XLA fuses the casts into the consumers.
@@ -1080,6 +1157,18 @@ def _schedule_jit(tensors: dict, n_zones: int, weights: Weights,
     t["n_zones"] = n_zones
     s = static_pass(t, feats, weights, explain=explain)
     obj_on = objective is not None and objective.enabled
+    if wave:
+        # wave-commit solve: same outputs as the serial branches below
+        # (bit-identical — tests/test_wave_parity.py), wrapped as
+        # (ret, wave_count) with wave_count a traced i32 scalar
+        from kubernetes_tpu.ops.wave import wave_commit
+        # `wave` is a static jit argument (a Python int at trace time)
+        ret, waves = wave_commit(t, s, weights, feats, explain=explain,
+                                 obj=objective if obj_on else None,
+                                 chunk=wave)
+        if explain:
+            ret[-1]["static_surv"] = s["static_surv"]
+        return ret, waves
     if not obj_on:
         if not explain:
             return greedy_commit(t, s, weights, feats)
@@ -1134,15 +1223,16 @@ _DISPATCHED: set = set()
 
 def _dispatch_key(arrays: dict, n_zones: int, weights: Weights,
                   feats: Features, explain: bool = False,
-                  objective: Optional[ObjectiveConfig] = None) -> tuple:
+                  objective: Optional[ObjectiveConfig] = None,
+                  wave: int = 0) -> tuple:
     shapes = tuple(sorted((k, tuple(v.shape), str(v.dtype))
                           for k, v in arrays.items()))
-    return shapes, n_zones, weights, feats, explain, objective
+    return shapes, n_zones, weights, feats, explain, objective, wave
 
 
 def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
              stage=None, explain: bool = False,
-             objective: Optional[ObjectiveConfig] = None):
+             objective: Optional[ObjectiveConfig] = None, wave: int = 0):
     """Run the jit'd solve with host materialization as the sync barrier.
 
     `stage(name, fn)` (the watchdog/span hook, ops/watchdog.run_stages) sees
@@ -1161,7 +1251,8 @@ def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
     from kubernetes_tpu.observability import profiling
     from kubernetes_tpu.utils import platform as plat
 
-    key = _dispatch_key(arrays, n_zones, weights, feats, explain, objective)
+    key = _dispatch_key(arrays, n_zones, weights, feats, explain, objective,
+                        wave)
     first = key not in _DISPATCHED
     name = "compile" if first else "solve"
 
@@ -1169,7 +1260,7 @@ def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
         before = plat.compile_cache_snapshot() if first else None
         t0 = _time.perf_counter()
         pending = _schedule_jit(arrays, n_zones, weights, feats, explain,
-                                objective)
+                                objective, wave)
         t_host = _time.perf_counter()
         # device execution + D2H, the sync barrier (every leaf when explain)
         out = jax.tree_util.tree_map(np.asarray, pending)
@@ -1185,9 +1276,22 @@ def dispatch(arrays: dict, n_zones: int, weights: Weights, feats: Features,
     return out
 
 
+def record_wave_count(out, wave: int):
+    """Split a wave dispatch's (ret, wave_count) pair, export the count as
+    the scheduler_kernel_wave_count gauge, and hand back the serial-shaped
+    ret. Pass-through when the serial path ran."""
+    if not wave:
+        return out
+    ret, waves = out
+    from kubernetes_tpu.utils.metrics import REGISTRY as METRICS
+    METRICS.set_gauge("scheduler_kernel_wave_count", float(waves))
+    return ret
+
+
 def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
                    device=None, stage=None, explain: bool = False,
-                   objective: Optional[ObjectiveConfig] = None):
+                   objective: Optional[ObjectiveConfig] = None,
+                   wave=None):
     """Schedule a tensorized batch; returns node name (or None) per pending
     pod, FIFO order. With explain, returns (names, decision records) — the
     records carry per-predicate survivor counts and winner/runner-up score
@@ -1203,6 +1307,7 @@ def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
     run = stage or (lambda _n, fn: fn())
     from kubernetes_tpu.scheduler.objectives.config import resolve_objective
     objective = resolve_objective(objective)
+    wave = resolve_wave(wave, n_pods=ct.n_real_pods)
 
     def _upload():
         import time as _time
@@ -1223,7 +1328,8 @@ def schedule_batch(ct: ClusterTensors, weights: Optional[Weights] = None,
 
     arrays = run("upload", _upload)
     out = dispatch(arrays, ct.n_zones, weights, feats, stage=stage,
-                   explain=explain, objective=objective)
+                   explain=explain, objective=objective, wave=wave)
+    out = record_wave_count(out, wave)
     return decode_dispatch(ct, out, weights, feats, explain, objective)
 
 

@@ -59,6 +59,11 @@ def partition_specs() -> Dict[str, object]:
         "pod_ebs": P("pods", None), "node_ebs0": P("nodes", None),
         "pod_gce": P("pods", None), "node_gce0": P("nodes", None),
         "max_ebs": P(), "max_gce": P(),
+        # objective-mode operands (scheduler/objectives/tensors.py)
+        "pod_priority": P("pods"), "vict_prio": P(None, "nodes"),
+        "vict_cum": P(None, None, "nodes"), "pod_gang": P("pods"),
+        "gang_dom0": P(), "gang_failed0": P(),
+        "node_gang_dom": P("nodes"),
     }
 
 
@@ -91,19 +96,24 @@ def shard_arrays(mesh, np_arrays: dict) -> dict:
     return out
 
 
-def schedule_batch_sharded(ct, mesh, weights=None) -> List[Optional[str]]:
-    """The sharded twin of kernel.schedule_batch: same program, inputs laid
-    out over the mesh; returns node name (or None) per pending pod."""
-    import jax
+def schedule_batch_sharded(ct, mesh, weights=None,
+                           wave=None) -> List[Optional[str]]:
+    """The sharded twin of kernel.schedule_batch: same program (wave or
+    serial, per kernel.resolve_wave), inputs laid out over the mesh;
+    returns node name (or None) per pending pod."""
     import numpy as np
 
     from kubernetes_tpu.ops.kernel import (
         Weights, _schedule_jit, assignments_to_names, features_of,
+        record_wave_count, resolve_wave,
     )
 
     weights = weights or Weights()
     feats = features_of(ct)
+    wv = resolve_wave(wave, n_pods=ct.n_real_pods)
     with mesh:
         arrays = shard_arrays(mesh, ct.arrays())
-        out = np.asarray(_schedule_jit(arrays, ct.n_zones, weights, feats))
+        out = _schedule_jit(arrays, ct.n_zones, weights, feats,
+                            False, None, wv)
+        out = np.asarray(record_wave_count(out, wv))
     return assignments_to_names(out, ct)

@@ -193,17 +193,20 @@ class ConfigFactory:
                                    batch_size: int = 4096, weights=None,
                                    strict: bool = False,
                                    stage_deadlines=None, explain=None,
-                                   objective=None):
+                                   objective=None, microbatch_ms: float = 0.0):
         """The TPU-backed batch scheduler (scheduler/tpu.py) with the oracle
         from the same provider as its device-failure fallback. `objective`
         selects a registered scheduling-objective mode
-        (scheduler/objectives: binpack / preempt / gang / combinations)."""
+        (scheduler/objectives: binpack / preempt / gang / combinations);
+        `microbatch_ms` > 0 accumulates arrivals for that window (or until
+        batch_size) before each solve instead of solving per-pop."""
         from kubernetes_tpu.scheduler.tpu import create_batch_scheduler
         return create_batch_scheduler(self, provider_name,
                                       batch_size=batch_size, weights=weights,
                                       strict=strict,
                                       stage_deadlines=stage_deadlines,
-                                      explain=explain, objective=objective)
+                                      explain=explain, objective=objective,
+                                      microbatch_ms=microbatch_ms)
 
     # --- lifecycle -----------------------------------------------------------
 

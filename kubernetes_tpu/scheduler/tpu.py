@@ -122,9 +122,16 @@ class BatchScheduler(Scheduler):
                  incremental: bool = True,
                  stage_deadlines: Optional[dict] = None,
                  explain: Optional[bool] = None,
-                 objective=None):
+                 objective=None, microbatch_ms: float = 0.0):
         super().__init__(factory, algorithm)
         self.batch_size = batch_size
+        # micro-batch window (ROADMAP item 2): after the first pop, wait up
+        # to this many ms for more arrivals (or a full batch) before the
+        # solve — one kernel round per window instead of per-burst, so the
+        # device-resident incremental path amortizes across arrivals.
+        # KTPU_MICROBATCH_MS is the env seam (the soak harness sets it).
+        self.microbatch_ms = microbatch_ms or float(
+            os.environ.get("KTPU_MICROBATCH_MS", 0) or 0)
         self.weights = weights or Weights()
         # scheduling-objective mode (scheduler/objectives): a name or an
         # ObjectiveConfig; None/default keeps the pre-objective kernel
@@ -270,6 +277,15 @@ class BatchScheduler(Scheduler):
         first = self.f.pending.pop(timeout=timeout)
         if first is None:
             return 0
+        if self.microbatch_ms > 0:
+            # accumulate the arrival window: solve every N ms or M pods,
+            # whichever fills first — the steady-state rounds-per-second
+            # knob (a full batch never waits)
+            deadline = time.monotonic() + self.microbatch_ms / 1000.0
+            while (len(self.f.pending) + 1 < self.batch_size
+                   and time.monotonic() < deadline
+                   and not self._stop.is_set()):
+                time.sleep(0.001)
         pods = [first] + self.f.pending.drain(self.batch_size - 1)
         if self.objective is not None and self.objective.gang:
             # all-or-nothing cannot survive a count-based batch slice: two
@@ -592,7 +608,7 @@ def create_batch_scheduler(factory: ConfigFactory,
                            strict: bool = False,
                            stage_deadlines: Optional[dict] = None,
                            explain: Optional[bool] = None,
-                           objective=None
+                           objective=None, microbatch_ms: float = 0.0
                            ) -> BatchScheduler:
     """Build a BatchScheduler whose fallback algorithm is the oracle built
     from the same provider (CreateFromProvider seam, factory.go:248-342).
@@ -625,4 +641,4 @@ def create_batch_scheduler(factory: ConfigFactory,
     return BatchScheduler(factory, algorithm, batch_size=batch_size,
                           weights=weights, strict=strict,
                           stage_deadlines=stage_deadlines, explain=explain,
-                          objective=obj_cfg)
+                          objective=obj_cfg, microbatch_ms=microbatch_ms)

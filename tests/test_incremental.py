@@ -327,7 +327,7 @@ class TestBrokenMirror:
             for i in range(3):
                 client.create("nodes", bnode(f"n-{i}"))
             factory = ConfigFactory(client)
-            factory.run()
+            factory.run(timeout=60)
             sched = factory.create_batch_from_provider(batch_size=16)
             old = sched._inc
             old.broken = "injected"
@@ -368,7 +368,7 @@ class TestSchedulerWiring:
             for i in range(3):
                 client.create("nodes", bnode(f"n-{i}"))
             factory = ConfigFactory(client)
-            factory.run()
+            factory.run(timeout=60)
             sched = factory.create_batch_from_provider(batch_size=16)
             assert sched._inc is not None
             assert sched._inc._hi == 3  # nodes mirrored via listener replay

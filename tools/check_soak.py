@@ -132,6 +132,28 @@ def check(doc: dict, expect_wedged: bool) -> list:
         need(steady, "pods_bound",
              lambda v: _is_num(v) and v > 0, "detail.steady_state",
              "positive (a clean soak must bind pods)")
+        # the micro-batch block: solve cadence + device-residency proof
+        mb = detail.get("microbatch")
+        if not isinstance(mb, dict):
+            errs.append("detail.microbatch: missing (the soak must report "
+                        "its solve cadence)")
+        else:
+            where = "detail.microbatch"
+            need(mb, "window_ms", _is_num, where, "number")
+            need(mb, "rounds", lambda v: _is_num(v) and v > 0, where,
+                 "positive (a clean soak must run kernel rounds)")
+            need(mb, "rounds_per_second",
+                 lambda v: v is None or _is_num(v), where,
+                 "number or null")
+            need(mb, "avg_pods_per_round",
+                 lambda v: v is None or _is_num(v), where,
+                 "number or null")
+            need(mb, "device_resident", lambda v: v is True, where,
+                 "true (the incremental device-resident path must be on)")
+            need(mb, "incremental_builds",
+                 lambda v: _is_num(v) and v > 0, where,
+                 "positive (solves must go through the incremental "
+                 "mirror, not per-round full re-tensorize)")
         need(detail, "unschedulable_reasons", _reasons_ok, "detail",
              "predicate -> count object scraped off the reasons counter")
         if gang_mode:

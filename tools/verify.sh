@@ -8,8 +8,15 @@
 #                         tracker + checked informer store); any recorded
 #                         inversion or cache mutation fails the test that
 #                         triggered it
+#   2b. wave-parity smoke — tools/wave_smoke.py solves the full-carry
+#                         smoke batch (and a gang_preempt batch) with the
+#                         serial scan AND the wave-commit solver and exits
+#                         1 unless every output (assignments, victims,
+#                         gang verdicts, explain extras) is bit-identical
+#
 #   3. soak smoke       — a ~10 s kubemark churn soak through
-#                         `bench.py --mode soak` (scraped SLIs, SLO
+#                         `bench.py --mode soak` (micro-batched arrivals
+#                         via SOAK_MICROBATCH_MS, scraped SLIs, SLO
 #                         verdicts, wedge detection), schema-checked by
 #                         tools/check_soak.py — the steady-state bench path
 #                         is exercised on every verify, not just on bench
@@ -80,10 +87,13 @@ if [ "$run_tests" = 1 ]; then
 fi
 
 if [ "$run_soak" = 1 ]; then
-  echo "== soak smoke (churn + scraped SLIs + schema check) =="
+  echo "== wave-parity smoke (serial vs wave commit, exact equality) =="
+  JAX_PLATFORMS=cpu timeout -k 10 300 python tools/wave_smoke.py
+
+  echo "== soak smoke (churn + micro-batch + scraped SLIs + schema check) =="
   soak_out="$(mktemp /tmp/soak-smoke.XXXXXX.json)"
   JAX_PLATFORMS=cpu SOAK_NODES=8 SOAK_RATE=40 SOAK_DURATION=4 \
-    SOAK_SCRAPE_PERIOD=1 SOAK_BATCH=32 \
+    SOAK_SCRAPE_PERIOD=1 SOAK_BATCH=32 SOAK_MICROBATCH_MS=25 \
     timeout -k 10 300 python bench.py --mode soak > "$soak_out"
   python tools/check_soak.py "$soak_out"
   rm -f "$soak_out"

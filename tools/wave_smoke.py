@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Wave-parity smoke (tools/verify.sh): the wave-commit solver must equal
+the serial per-pod scan EXACTLY at the smoke shape — assignments, objective
+outputs, and explain extras, bit for bit — or this exits 1.
+
+Covers the default full-carry-surface batch (ports, disks, volumes,
+inter-pod terms, sym/te tables) with explain on, plus a gang_preempt batch,
+and asserts the wave count actually shrank the serial dimension.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from kubernetes_tpu.utils.platform import force_cpu
+    force_cpu(device_count=1)
+
+    import jax
+    import numpy as np
+
+    from kubernetes_tpu.ops.fixtures import feature_batch
+    from kubernetes_tpu.ops.kernel import Weights, _schedule_jit, features_of
+
+    def solve(ct, obj, explain, wave):
+        import jax.numpy as jnp
+        arrays = {k: jnp.asarray(v) for k, v in ct.arrays().items()}
+        out = _schedule_jit(arrays, ct.n_zones, Weights(), features_of(ct),
+                            explain, obj, wave)
+        return jax.tree_util.tree_map(np.asarray, out)
+
+    failures = []
+
+    def compare(name, serial, wavey):
+        ls = jax.tree_util.tree_flatten_with_path(serial)[0]
+        lw = jax.tree_util.tree_flatten_with_path(wavey)[0]
+        if len(ls) != len(lw):
+            failures.append(f"{name}: output tree structure differs")
+            return
+        for (pa, va), (_pb, vb) in zip(ls, lw):
+            if not np.array_equal(np.asarray(va), np.asarray(vb)):
+                failures.append(
+                    f"{name}: {jax.tree_util.keystr(pa)} differs")
+
+    # 1) full-carry default batch, explain on
+    ct = feature_batch(n_nodes=48, n_pods=32, with_existing=True)
+    serial = solve(ct, None, True, 0)
+    wavey, waves = solve(ct, None, True, 16)
+    compare("default/explain", serial, wavey)
+    if int(waves) >= ct.n_real_pods:
+        failures.append(
+            f"default/explain: wave_count {int(waves)} did not shrink the "
+            f"serial dimension ({ct.n_real_pods} pods)")
+    print(f"wave_smoke: default/explain waves={int(waves)} "
+          f"pods={ct.n_real_pods}")
+
+    # 2) gang_preempt batch (atomic interaction groups through the wave)
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.ops.tensorize import Tensorizer
+    from kubernetes_tpu.scheduler.batch import make_plugin_args
+    from kubernetes_tpu.scheduler.objectives.config import (
+        GANG_LABEL, PRIORITY_ANNOTATION, gang_order, get_objective,
+    )
+
+    def mk_pod(name, cpu, labels=None, ann=None, node=""):
+        return api.Pod(
+            metadata=api.ObjectMeta(name=name, namespace="default",
+                                    labels=labels, annotations=ann),
+            spec=api.PodSpec(node_name=node, containers=[api.Container(
+                name="c", image="pause",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": cpu, "memory": "256Mi"}))]))
+
+    nodes = [api.Node(
+        metadata=api.ObjectMeta(
+            name=f"n{i:02d}",
+            labels={api.LABEL_HOSTNAME: f"n{i:02d}",
+                    api.LABEL_ZONE: f"z{i % 4}"}),
+        status=api.NodeStatus(
+            allocatable={"cpu": "4", "memory": "16Gi", "pods": "16"},
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+        for i in range(16)]
+    existing = [mk_pod(f"e{i:02d}", "1500m", node=f"n{i % 16:02d}",
+                       ann={PRIORITY_ANNOTATION: str(i % 2)})
+                for i in range(32)]
+    pending = []
+    for i in range(24):
+        labels, ann = {}, None
+        if i % 3 == 0:
+            labels[GANG_LABEL] = f"g{i // 9}"
+        elif i % 5 == 1:
+            ann = {PRIORITY_ANNOTATION: "7"}
+        pending.append(mk_pod(f"p{i:02d}", "900m", labels=labels, ann=ann))
+    obj = get_objective("gang_preempt")
+    pending, _ = gang_order(pending)
+    ct2 = Tensorizer(plugin_args=make_plugin_args(nodes),
+                     objective=obj).build(nodes, existing, pending)
+    serial2 = solve(ct2, obj, True, 0)
+    wavey2, waves2 = solve(ct2, obj, True, 8)
+    compare("gang_preempt/explain", serial2, wavey2)
+    print(f"wave_smoke: gang_preempt/explain waves={int(waves2)} "
+          f"pods={ct2.n_real_pods}")
+
+    if failures:
+        for f in failures:
+            print(f"wave_smoke: FAIL {f}", file=sys.stderr)
+        return 1
+    print("wave_smoke: OK (wave == serial bit-for-bit)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
